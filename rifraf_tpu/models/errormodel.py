@@ -1,0 +1,80 @@
+"""Error model and alignment scores.
+
+Mirrors /root/reference/src/errormodel.jl: an ErrorModel holds relative rates
+of each error kind; Scores are the log10-normalized rates plus optional extra
+penalties (codon indels get 3x the single-indel extra penalty,
+errormodel.jl:75-80).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ErrorModel:
+    """Relative rates of each kind of sequencing error (errormodel.jl:19-30)."""
+
+    mismatch: float
+    insertion: float
+    deletion: float
+    codon_insertion: float = 0.0
+    codon_deletion: float = 0.0
+
+    def normalize(self) -> "ErrorModel":
+        """Turn error rates into probabilities (errormodel.jl:33-41)."""
+        args = np.array(
+            [
+                self.mismatch,
+                self.insertion,
+                self.deletion,
+                self.codon_insertion,
+                self.codon_deletion,
+            ],
+            dtype=np.float64,
+        )
+        m, i, d, ci, cd = args / args.sum()
+        return ErrorModel(m, i, d, ci, cd)
+
+
+@dataclass(frozen=True)
+class Scores:
+    """Log10 alignment penalties (errormodel.jl:43-49). All fields <= 0."""
+
+    mismatch: float
+    insertion: float
+    deletion: float
+    codon_insertion: float = -np.inf
+    codon_deletion: float = -np.inf
+
+    @classmethod
+    def from_error_model(
+        cls,
+        errors: ErrorModel,
+        mismatch: float = 0.0,
+        insertion: float = 0.0,
+        deletion: float = 0.0,
+    ) -> "Scores":
+        """Derive scores from an error model plus extra penalties
+        (errormodel.jl:66-81)."""
+        args = np.array(
+            [
+                errors.mismatch,
+                errors.insertion,
+                errors.deletion,
+                errors.codon_insertion,
+                errors.codon_deletion,
+            ],
+            dtype=np.float64,
+        )
+        with np.errstate(divide="ignore"):
+            m, i, d, ci, cd = np.log10(args / args.sum())
+        return cls(
+            mismatch=float(m + mismatch),
+            insertion=float(i + insertion),
+            deletion=float(d + deletion),
+            codon_insertion=float(ci + 3 * insertion),
+            codon_deletion=float(cd + 3 * deletion),
+        )

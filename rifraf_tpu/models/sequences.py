@@ -1,0 +1,272 @@
+"""Per-read score precompute and batched device layout.
+
+`ReadScores` mirrors the reference's RifrafSequence
+(/root/reference/src/rifrafsequences.jl:19-81): a read plus per-position score
+vectors, precomputed so the DP inner loop does no math. The TPU-native twist
+is `ReadBatch`: N reads padded to a common length and stacked into dense
+arrays, ready to be vmapped over on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from ..utils.constants import encode_seq
+from ..utils.phred import phred_to_log_p
+from .errormodel import Scores
+
+NEG_INF = -np.inf
+
+
+@dataclass
+class ReadScores:
+    """A read plus precomputed per-base alignment score vectors.
+
+    Score vector semantics (rifrafsequences.jl:40-72, all log10):
+      - match_scores[i]    = log10(1 - p_i)
+      - mismatch_scores[i] = log10(p_i) + scores.mismatch
+      - ins_scores[i]      = log10(p_i) + scores.insertion
+      - del_scores (len n+1): del_scores[i] = max(log_p[i-1], log_p[i]) +
+        scores.deletion, symmetric at the ends
+      - codon_ins_scores (len n-2): max of 3 neighbors + scores.codon_insertion
+      - codon_del_scores (len n+1): like del_scores with codon penalty
+    """
+
+    seq: np.ndarray  # int8 codes [n]
+    error_log_p: np.ndarray  # float64 [n]
+    est_n_errors: float
+    match_scores: np.ndarray
+    mismatch_scores: np.ndarray
+    ins_scores: np.ndarray
+    del_scores: np.ndarray  # [n + 1]
+    codon_ins_scores: Optional[np.ndarray]  # [n - 2] or None
+    codon_del_scores: Optional[np.ndarray]  # [n + 1] or None
+    bandwidth: int
+    scores: Scores
+    bandwidth_fixed: bool = False
+
+    def __len__(self) -> int:
+        return len(self.seq)
+
+    @property
+    def do_codon_ins(self) -> bool:
+        return self.codon_ins_scores is not None
+
+    @property
+    def do_codon_del(self) -> bool:
+        return self.codon_del_scores is not None
+
+    @property
+    def do_codon_moves(self) -> bool:
+        return self.do_codon_ins or self.do_codon_del
+
+    def with_scores(self, scores: Scores) -> "ReadScores":
+        """Recompute score vectors with new penalties, keeping bandwidth state
+        (rifrafsequences.jl:90-94)."""
+        result = make_read_scores(self.seq, self.error_log_p, self.bandwidth, scores)
+        result.bandwidth_fixed = self.bandwidth_fixed
+        return result
+
+    def reversed(self) -> "ReadScores":
+        """Score vectors for the reversed read, used by the backward pass.
+
+        Matches align.jl's `doreverse` index arithmetic (align.jl:64-68,
+        88-99): every per-base score vector is simply reversed.
+        """
+        out = replace(
+            self,
+            seq=self.seq[::-1].copy(),
+            error_log_p=self.error_log_p[::-1].copy(),
+            match_scores=self.match_scores[::-1].copy(),
+            mismatch_scores=self.mismatch_scores[::-1].copy(),
+            ins_scores=self.ins_scores[::-1].copy(),
+            del_scores=self.del_scores[::-1].copy(),
+            codon_ins_scores=(
+                None if self.codon_ins_scores is None else self.codon_ins_scores[::-1].copy()
+            ),
+            codon_del_scores=(
+                None if self.codon_del_scores is None else self.codon_del_scores[::-1].copy()
+            ),
+        )
+        return out
+
+
+def make_read_scores(
+    seq,
+    error_log_p,
+    bandwidth: int,
+    scores: Scores,
+) -> ReadScores:
+    """Build a ReadScores (rifrafsequences.jl:19-81).
+
+    `seq` may be a DNA string or an int8 code array.
+    """
+    if isinstance(seq, str):
+        seq = encode_seq(seq)
+    seq = np.asarray(seq, dtype=np.int8)
+    error_log_p = np.asarray(error_log_p, dtype=np.float64)
+
+    if bandwidth < 1:
+        raise ValueError("bandwidth must be positive")
+    if len(seq) != len(error_log_p):
+        raise ValueError("length mismatch")
+    n = len(seq)
+    if n == 0:
+        return empty_read_scores(scores)
+    if np.min(error_log_p) == -np.inf:
+        raise ValueError("a log error probability is negative infinity")
+    if np.max(error_log_p) > 0.0:
+        raise ValueError(f"a log error probability is > 0: {np.max(error_log_p)}")
+
+    error_p = np.power(10.0, error_log_p)
+    match_scores = np.log10(1.0 - error_p)
+    mismatch_scores = error_log_p + scores.mismatch
+    ins_scores = error_log_p + scores.insertion
+
+    # del_scores[i] = max of neighboring log error probs + penalty; symmetric
+    # at the ends (rifrafsequences.jl:49-53)
+    del_scores = np.empty(n + 1, dtype=np.float64)
+    del_scores[0] = error_log_p[0] + scores.deletion
+    del_scores[-1] = error_log_p[-1] + scores.deletion
+    if n > 1:
+        del_scores[1:n] = np.maximum(error_log_p[:-1], error_log_p[1:]) + scores.deletion
+
+    codon_ins_scores = None
+    if scores.codon_insertion > -np.inf:
+        if n >= 3:
+            # codon_ins_scores[i] = max(log_p[i], log_p[i+1], log_p[i+2]) + penalty
+            # (rifrafsequences.jl:58-63, shifted to 0-based)
+            codon_ins_scores = (
+                np.maximum.reduce([error_log_p[:-2], error_log_p[1:-1], error_log_p[2:]])
+                + scores.codon_insertion
+            )
+        else:
+            codon_ins_scores = np.zeros(0, dtype=np.float64)
+
+    codon_del_scores = None
+    if scores.codon_deletion > -np.inf:
+        codon_del_scores = np.empty(n + 1, dtype=np.float64)
+        codon_del_scores[0] = error_log_p[0] + scores.codon_deletion
+        codon_del_scores[-1] = error_log_p[-1] + scores.codon_deletion
+        if n > 1:
+            codon_del_scores[1:n] = (
+                np.maximum(error_log_p[:-1], error_log_p[1:]) + scores.codon_deletion
+            )
+
+    est_n_errors = float(np.sum(error_p))
+
+    return ReadScores(
+        seq=seq,
+        error_log_p=error_log_p,
+        est_n_errors=est_n_errors,
+        match_scores=match_scores,
+        mismatch_scores=mismatch_scores,
+        ins_scores=ins_scores,
+        del_scores=del_scores,
+        codon_ins_scores=codon_ins_scores,
+        codon_del_scores=codon_del_scores,
+        bandwidth=bandwidth,
+        scores=scores,
+    )
+
+
+def read_scores_from_phreds(seq, phreds, bandwidth: int, scores: Scores) -> ReadScores:
+    """Build from PHRED values instead of log error rates
+    (rifrafsequences.jl:84-87)."""
+    return make_read_scores(seq, phred_to_log_p(phreds), bandwidth, scores)
+
+
+def empty_read_scores(scores: Scores) -> ReadScores:
+    """Empty sequence (rifrafsequences.jl:97-100)."""
+    z = np.zeros(0, dtype=np.float64)
+    return ReadScores(
+        seq=np.zeros(0, dtype=np.int8),
+        error_log_p=z,
+        est_n_errors=0.0,
+        match_scores=z,
+        mismatch_scores=z,
+        ins_scores=z,
+        del_scores=z,
+        codon_ins_scores=None,
+        codon_del_scores=None,
+        bandwidth=0,
+        scores=scores,
+    )
+
+
+class ReadBatch(NamedTuple):
+    """N reads padded to length L and stacked for the device.
+
+    Padding positions carry harmless finite scores; every kernel masks by
+    `lengths`. `cins`/`cdel` are all -inf when codon moves are disabled, which
+    uniformly disables those moves in the kernels.
+    """
+
+    seq: np.ndarray  # int8 [N, L], padded with GAP_INT
+    lengths: np.ndarray  # int32 [N]
+    match: np.ndarray  # [N, L]
+    mismatch: np.ndarray  # [N, L]
+    ins: np.ndarray  # [N, L]
+    dels: np.ndarray  # [N, L + 1]
+    cins: np.ndarray  # [N, L] (index i <-> codon_ins_scores[i], valid i <= n-3)
+    cdel: np.ndarray  # [N, L + 1]
+    bandwidth: np.ndarray  # int32 [N]
+
+    @property
+    def n_reads(self) -> int:
+        return self.seq.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.seq.shape[1]
+
+
+def batch_reads(reads: Sequence[ReadScores], max_len: Optional[int] = None, dtype=np.float32) -> ReadBatch:
+    """Pad and stack ReadScores into a ReadBatch."""
+    n = len(reads)
+    if n == 0:
+        raise ValueError("cannot batch zero reads")
+    length = max(len(r) for r in reads)
+    if max_len is not None:
+        if max_len < length:
+            raise ValueError("max_len smaller than longest read")
+        length = max_len
+
+    seq = np.full((n, length), -1, dtype=np.int8)
+    lengths = np.zeros(n, dtype=np.int32)
+    match = np.zeros((n, length), dtype=dtype)
+    mismatch = np.zeros((n, length), dtype=dtype)
+    ins = np.zeros((n, length), dtype=dtype)
+    dels = np.zeros((n, length + 1), dtype=dtype)
+    cins = np.full((n, length), NEG_INF, dtype=dtype)
+    cdel = np.full((n, length + 1), NEG_INF, dtype=dtype)
+    bandwidth = np.zeros(n, dtype=np.int32)
+
+    for k, r in enumerate(reads):
+        m = len(r)
+        lengths[k] = m
+        seq[k, :m] = r.seq
+        match[k, :m] = r.match_scores
+        mismatch[k, :m] = r.mismatch_scores
+        ins[k, :m] = r.ins_scores
+        dels[k, : m + 1] = r.del_scores
+        if r.codon_ins_scores is not None and len(r.codon_ins_scores) > 0:
+            cins[k, : m - 2] = r.codon_ins_scores
+        if r.codon_del_scores is not None:
+            cdel[k, : m + 1] = r.codon_del_scores
+        bandwidth[k] = r.bandwidth
+
+    return ReadBatch(
+        seq=seq,
+        lengths=lengths,
+        match=match,
+        mismatch=mismatch,
+        ins=ins,
+        dels=dels,
+        cins=cins,
+        cdel=cdel,
+        bandwidth=bandwidth,
+    )

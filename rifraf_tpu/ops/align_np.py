@@ -1,0 +1,360 @@
+"""Reference (numpy, cell-by-cell) banded alignment engine.
+
+A faithful 0-based re-statement of /root/reference/src/align.jl. This is the
+exactness oracle for the vectorized JAX/Pallas kernels and the host fallback
+for tiny problems (e.g. consensus-vs-reference alignment during frame
+correction). The hot path for real workloads is rifraf_tpu.ops.align_jax.
+
+Trace codes and move offsets follow align.jl:4-18.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..models.sequences import ReadScores
+from ..utils.constants import CODON_LENGTH, GAP_INT
+from .banded_array import BandedArray, equal_ranges
+
+# Trace codes (align.jl:7-12)
+TRACE_NONE = 0
+TRACE_MATCH = 1
+TRACE_INSERT = 2
+TRACE_DELETE = 3
+TRACE_CODON_INSERT = 4
+TRACE_CODON_DELETE = 5
+
+# (di, dj) move offsets (align.jl:14-18), indexed by trace code
+OFFSETS = {
+    TRACE_MATCH: (1, 1),
+    TRACE_INSERT: (1, 0),
+    TRACE_DELETE: (0, 1),
+    TRACE_CODON_INSERT: (3, 0),
+    TRACE_CODON_DELETE: (0, 3),
+}
+
+
+def offset_forward(move: int, i: int, j: int) -> Tuple[int, int]:
+    a, b = OFFSETS[move]
+    return i + a, j + b
+
+
+def offset_backward(move: int, i: int, j: int) -> Tuple[int, int]:
+    a, b = OFFSETS[move]
+    return i - a, j - b
+
+
+def update(
+    A: BandedArray,
+    i: int,
+    j: int,
+    s_base: int,
+    t_base: int,
+    pseq: ReadScores,
+    newcols: Optional[np.ndarray] = None,
+    acol: int = -1,
+    trim: bool = False,
+    skew_matches: bool = False,
+) -> Tuple[float, int]:
+    """Score one DP cell: max over moves into (i, j) (align.jl:50-112).
+
+    (i, j) are 0-based cell indices in the (slen+1, tlen+1) DP matrix; cell
+    (i, j) scores aligning s[:i] to t[:j]. When `acol >= 0`, columns > acol
+    are read from `newcols[:, col - acol - 1]` instead of A (the proposal
+    rescoring trick, model.jl:242-285).
+    """
+    nrows, ncols = A.shape
+    seqlen = len(pseq)
+    # clamped per-base score index (align.jl:64): i chars consumed -> scores
+    # of s[i-1]
+    seq_i = max(i - 1, 0)
+    match_score = (
+        pseq.match_scores[seq_i] if s_base == t_base else pseq.mismatch_scores[seq_i]
+    )
+    ins_score = pseq.ins_scores[seq_i]
+    del_score = pseq.del_scores[i]
+
+    if skew_matches and s_base != t_base:
+        match_score *= 0.99
+    # allow terminal insertions for free (align.jl:73-76)
+    if trim and (j == 0 or j == ncols - 1):
+        ins_score = 0.0
+
+    final_score = -np.inf
+    final_move = TRACE_NONE
+
+    def helper(final_score, final_move, move_score, move):
+        prev_i, prev_j = offset_backward(move, i, j)
+        rangecol = min(prev_j, ncols - 1)
+        if A.inband(prev_i, rangecol):
+            if acol < 0 or prev_j <= acol:
+                score = A[prev_i, prev_j] + move_score
+            else:
+                score = newcols[prev_i, prev_j - acol - 1] + move_score
+            if score > final_score:
+                return score, move
+        return final_score, final_move
+
+    final_score, final_move = helper(final_score, final_move, match_score, TRACE_MATCH)
+    final_score, final_move = helper(final_score, final_move, ins_score, TRACE_INSERT)
+    final_score, final_move = helper(final_score, final_move, del_score, TRACE_DELETE)
+
+    if pseq.do_codon_moves:
+        if pseq.do_codon_ins and i >= CODON_LENGTH:
+            codon_ins_score = pseq.codon_ins_scores[i - CODON_LENGTH]
+            final_score, final_move = helper(
+                final_score, final_move, codon_ins_score, TRACE_CODON_INSERT
+            )
+        if pseq.do_codon_del and j >= CODON_LENGTH:
+            codon_del_score = pseq.codon_del_scores[i]
+            final_score, final_move = helper(
+                final_score, final_move, codon_del_score, TRACE_CODON_DELETE
+            )
+    if final_score == -np.inf:
+        raise RuntimeError("new score is invalid")
+    if final_move == TRACE_NONE:
+        raise RuntimeError("failed to find a move")
+    return final_score, final_move
+
+
+def forward_moves_inplace(
+    t: np.ndarray,
+    s: ReadScores,
+    result: BandedArray,
+    moves: BandedArray,
+    trim: bool = False,
+    skew_matches: bool = False,
+) -> None:
+    """Banded forward DP recording traceback moves (align.jl:114-141)."""
+    new_shape = (len(s) + 1, len(t) + 1)
+    result.newbandwidth(s.bandwidth)
+    moves.newbandwidth(s.bandwidth)
+    result.resize(new_shape)
+    moves.resize(new_shape)
+    result.data.fill(-np.inf)
+    moves.data.fill(TRACE_NONE)
+    result[0, 0] = 0.0
+    nrows, ncols = new_shape
+    for j in range(ncols):
+        start, stop = result.row_range(j)
+        for i in range(start, stop + 1):
+            if i == 0 and j == 0:
+                continue
+            sbase = s.seq[i - 1] if i > 0 else GAP_INT
+            tbase = t[j - 1] if j > 0 else GAP_INT
+            score, move = update(
+                result, i, j, sbase, tbase, s, trim=trim, skew_matches=skew_matches
+            )
+            result[i, j] = score
+            moves[i, j] = move
+
+
+def forward_moves(
+    t: np.ndarray, s: ReadScores, trim: bool = False, skew_matches: bool = False
+) -> Tuple[BandedArray, BandedArray]:
+    """Banded forward DP + traceback matrix (align.jl:144-153)."""
+    shape = (len(s) + 1, len(t) + 1)
+    result = BandedArray(shape, s.bandwidth, default=-np.inf)
+    moves = BandedArray(shape, s.bandwidth, default=TRACE_NONE, dtype=np.int8)
+    forward_moves_inplace(t, s, result, moves, trim=trim, skew_matches=skew_matches)
+    return result, moves
+
+
+def forward_inplace(
+    t: np.ndarray,
+    s: ReadScores,
+    result: BandedArray,
+    doreverse: bool = False,
+    trim: bool = False,
+    skew_matches: bool = False,
+) -> None:
+    """Banded forward fill without moves (align.jl:155-179).
+
+    With `doreverse`, aligns the reversed sequences (used by backward)
+    without materializing them, exactly like align.jl:171-172.
+    """
+    new_shape = (len(s) + 1, len(t) + 1)
+    result.newbandwidth(s.bandwidth)
+    result.resize(new_shape)
+    result.data.fill(-np.inf)
+    result[0, 0] = 0.0
+    nrows, ncols = new_shape
+    rs = s.reversed() if doreverse else s
+    t_eff = t[::-1] if doreverse else t
+    for j in range(ncols):
+        start, stop = result.row_range(j)
+        for i in range(start, stop + 1):
+            if i == 0 and j == 0:
+                continue
+            sbase = rs.seq[i - 1] if i > 0 else GAP_INT
+            tbase = t_eff[j - 1] if j > 0 else GAP_INT
+            score, _ = update(
+                result, i, j, sbase, tbase, rs, trim=trim, skew_matches=skew_matches
+            )
+            result[i, j] = score
+
+
+def forward(
+    t: np.ndarray,
+    s: ReadScores,
+    doreverse: bool = False,
+    trim: bool = False,
+    skew_matches: bool = False,
+) -> BandedArray:
+    """F[i, j] = best log10 prob of aligning s[:i] to t[:j] (align.jl:185-194)."""
+    result = BandedArray((len(s) + 1, len(t) + 1), s.bandwidth, default=-np.inf)
+    forward_inplace(t, s, result, doreverse=doreverse, trim=trim, skew_matches=skew_matches)
+    return result
+
+
+def backward_inplace(t: np.ndarray, s: ReadScores, result: BandedArray) -> None:
+    """Backward DP = forward on reversed sequences, flipped (align.jl:196-202)."""
+    forward_inplace(t, s, result, doreverse=True)
+    result.flip()
+
+
+def backward(t: np.ndarray, s: ReadScores) -> BandedArray:
+    """B[i, j] = best log10 prob of aligning s[i:] to t[j:] (align.jl:208-212)."""
+    result = forward(t, s, doreverse=True)
+    result.flip()
+    return result
+
+
+def backtrace(moves: BandedArray) -> List[int]:
+    """Walk the move matrix from the bottom-right corner (align.jl:229-238)."""
+    taken = []
+    i, j = moves.nrows - 1, moves.ncols - 1
+    while i > 0 or j > 0:
+        m = int(moves[i, j])
+        taken.append(m)
+        i, j = offset_backward(m, i, j)
+    return taken[::-1]
+
+
+def backtrace_indices(moves: BandedArray, start=None) -> List[Tuple[int, int]]:
+    """Cell indices visited by the backtrace (align.jl:214-227)."""
+    result = []
+    if start is None:
+        i, j = moves.nrows - 1, moves.ncols - 1
+    else:
+        i, j = start
+    while i > 0 or j > 0:
+        m = int(moves[i, j])
+        i, j = offset_backward(m, i, j)
+        result.append((i, j))
+    return result[::-1]
+
+
+def moves_to_aligned_seqs(
+    moves: List[int], t: np.ndarray, s: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Reconstruct gapped alignment strings as int8 arrays with GAP_INT gaps
+    (align.jl:286-311)."""
+    aligned_t: List[int] = []
+    aligned_s: List[int] = []
+    i, j = -1, -1
+    for move in moves:
+        di, dj = OFFSETS[move]
+        i += di
+        j += dj
+        if move == TRACE_MATCH:
+            aligned_t.append(t[j])
+            aligned_s.append(s[i])
+        elif move == TRACE_INSERT:
+            aligned_t.append(GAP_INT)
+            aligned_s.append(s[i])
+        elif move == TRACE_DELETE:
+            aligned_t.append(t[j])
+            aligned_s.append(GAP_INT)
+        elif move == TRACE_CODON_INSERT:
+            aligned_t.extend([GAP_INT] * 3)
+            aligned_s.extend([s[i - 2], s[i - 1], s[i]])
+        elif move == TRACE_CODON_DELETE:
+            aligned_t.extend([t[j - 2], t[j - 1], t[j]])
+            aligned_s.extend([GAP_INT] * 3)
+    return np.array(aligned_t, dtype=np.int8), np.array(aligned_s, dtype=np.int8)
+
+
+def moves_to_indices(moves: List[int], tlen: int, slen: int) -> np.ndarray:
+    """Index vector mapping positions in t to positions in s (align.jl:322-335).
+
+    One entry per move that advances the template position (codon deletes
+    contribute a single entry, matching align.jl:327-333).
+    """
+    result = []
+    i, j = 0, 0
+    last_j = 0
+    for move in moves:
+        di, dj = OFFSETS[move]
+        i += di
+        j += dj
+        if j > last_j:
+            result.append(i)
+            last_j = j
+    return np.array(result, dtype=np.int64)
+
+
+def count_errors_in_moves(moves_arr: BandedArray, t: np.ndarray, s: np.ndarray) -> int:
+    """Number of aligned-column mismatches along the traceback
+    (align.jl:240-245)."""
+    moves = backtrace(moves_arr)
+    a, b = moves_to_aligned_seqs(moves, t, s)
+    return int(np.sum(a != b))
+
+
+def count_errors(t: np.ndarray, s: ReadScores) -> int:
+    """align.jl:247-250."""
+    _, amoves = forward_moves(t, s, skew_matches=True)
+    return count_errors_in_moves(amoves, t, s.seq)
+
+
+def edit_distance(t: np.ndarray, s: np.ndarray) -> int:
+    """Approximate Levenshtein distance via skewed alignment (align.jl:253-260)."""
+    from ..models.errormodel import ErrorModel, Scores
+    from ..models.sequences import make_read_scores
+
+    log_ps = np.full(len(s), -1.0)
+    bandwidth = int(np.ceil(min(len(t), len(s)) * 0.5))
+    scores = Scores.from_error_model(ErrorModel(1.0, 1.0, 1.0))
+    seq = make_read_scores(s, log_ps, max(bandwidth, 1), scores)
+    _, amoves = forward_moves(t, seq, skew_matches=True)
+    return count_errors_in_moves(amoves, t, s)
+
+
+def band_tolerance(amoves: BandedArray) -> int:
+    """Minimum distance of the traceback path from the band edge
+    (align.jl:262-284)."""
+    nrows, ncols = amoves.shape
+    dist = nrows
+    i, j = nrows - 1, ncols - 1
+    while i > 0 or j > 0:
+        start, stop = amoves.row_range(j)
+        if start > 0:
+            dist = min(dist, abs(i - start))
+        if stop < nrows - 1:
+            dist = min(dist, abs(i - stop))
+        i, j = offset_backward(int(amoves[i, j]), i, j)
+    start, stop = amoves.row_range(j)
+    if start > 0:
+        dist = min(dist, abs(i - start))
+    if stop < nrows - 1:
+        dist = min(dist, abs(i - stop))
+    return dist
+
+
+def align_moves(
+    t: np.ndarray, s: ReadScores, trim: bool = False, skew_matches: bool = False
+) -> List[int]:
+    """align.jl:337-344."""
+    _, amoves = forward_moves(t, s, trim=trim, skew_matches=skew_matches)
+    return backtrace(amoves)
+
+
+def align(
+    t: np.ndarray, s: ReadScores, trim: bool = False, skew_matches: bool = False
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Align and return gapped sequences (align.jl:346-353)."""
+    moves = align_moves(t, s, trim=trim, skew_matches=skew_matches)
+    return moves_to_aligned_seqs(moves, t, s.seq)

@@ -1,0 +1,161 @@
+"""Banded 2-D array: dense storage only within a diagonal band.
+
+Host-side (numpy) mirror of /root/reference/src/bandedarrays.jl:5-231, with
+0-based indexing. Element [i, j] of the logical (nrows x ncols) array lives at
+``data[(i - j) + h_offset + bandwidth, j]``; out-of-band reads return
+`default`, out-of-band writes raise.
+
+This class is the exactness oracle for the device kernels (which use the same
+memory layout, transposed to (col, diag) order) and part of the public API for
+parity with the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def ndatarows(nrows: int, ncols: int, bandwidth: int) -> int:
+    """Number of used data rows (bandedarrays.jl:101-104)."""
+    return 2 * bandwidth + abs(nrows - ncols) + 1
+
+
+def bandlimits(nrows: int, ncols: int, bandwidth: int):
+    """Limits on (i - j) for in-band cells (bandedarrays.jl:44-53)."""
+    if ncols > nrows:
+        return nrows - ncols - bandwidth, bandwidth
+    return -bandwidth, nrows - ncols + bandwidth
+
+
+def equal_ranges(a_range, b_range):
+    """Overlap of two sub-columns given their true row ranges
+    (bandedarrays.jl:220-231). Ranges are inclusive (start, stop), 0-based;
+    returns 0-based half-open index ranges into each sub-column."""
+    a_start, a_stop = a_range
+    b_start, b_stop = b_range
+    alen = a_stop - a_start + 1
+    blen = b_stop - b_start + 1
+    amin = max(b_start - a_start, 0)
+    amax = alen - max(a_stop - b_stop, 0)
+    bmin = max(a_start - b_start, 0)
+    bmax = blen - max(b_stop - a_stop, 0)
+    return (amin, amax), (bmin, bmax)
+
+
+class BandedArray:
+    """Banded array with out-of-band default (bandedarrays.jl:5-42)."""
+
+    def __init__(self, shape, bandwidth: int, default=0.0, dtype=np.float64):
+        if bandwidth < 1:
+            raise ValueError("bandwidth must be positive")
+        self.dtype = np.dtype(dtype)
+        self.default = self.dtype.type(default)
+        self.bandwidth = bandwidth
+        self._set_shape(shape)
+        self.data = np.zeros((ndatarows(*shape, bandwidth), shape[1]), dtype=dtype)
+
+    def _set_shape(self, shape):
+        nrows, ncols = shape
+        self.nrows = nrows
+        self.ncols = ncols
+        self.h_offset = max(ncols - nrows, 0)
+        self.v_offset = max(nrows - ncols, 0)
+        self.lower, self.upper = bandlimits(nrows, ncols, self.bandwidth)
+
+    @property
+    def shape(self):
+        return (self.nrows, self.ncols)
+
+    def resize(self, shape) -> None:
+        """Change logical shape, reallocating only if needed
+        (bandedarrays.jl:80-93)."""
+        self._set_shape(shape)
+        drows, dcols = self.data.shape
+        need_rows = ndatarows(self.nrows, self.ncols, self.bandwidth)
+        if need_rows > drows or self.ncols > dcols:
+            self.data = np.zeros((need_rows, self.ncols), dtype=self.dtype)
+
+    def newbandwidth(self, bandwidth: int) -> None:
+        """Change bandwidth, reallocating (bandedarrays.jl:95-98)."""
+        self.bandwidth = bandwidth
+        self._set_shape((self.nrows, self.ncols))
+        self.data = np.zeros(
+            (ndatarows(self.nrows, self.ncols, bandwidth), self.ncols),
+            dtype=self.dtype,
+        )
+
+    def inband(self, i: int, j: int) -> bool:
+        """Is [i, j] in the banded region? (bandedarrays.jl:152-157)"""
+        if i < 0 or j < 0 or i >= self.nrows or j >= self.ncols:
+            return False
+        return self.lower <= i - j <= self.upper
+
+    def data_row(self, i: int, j: int) -> int:
+        """The data row holding element [i, j] (bandedarrays.jl:109-114)."""
+        if not self.inband(i, j):
+            raise IndexError(f"[{i}, {j}] is not in band")
+        return (i - j) + self.h_offset + self.bandwidth
+
+    def row_range(self, j: int):
+        """Inclusive (start, stop) rows of column j that are dense
+        (bandedarrays.jl:133-137)."""
+        start = max(0, j - self.h_offset - self.bandwidth)
+        stop = min(j + self.v_offset + self.bandwidth, self.nrows - 1)
+        return start, stop
+
+    def data_row_range(self, j: int):
+        a, b = self.row_range(j)
+        return self.data_row(a, j), self.data_row(b, j)
+
+    def sparsecol(self, j: int) -> np.ndarray:
+        """View of the in-band elements of column j (bandedarrays.jl:146-149)."""
+        start, stop = self.data_row_range(j)
+        return self.data[start : stop + 1, j]
+
+    def __getitem__(self, idx):
+        i, j = idx
+        if self.inband(i, j):
+            return self.data[self.data_row(i, j), j]
+        return self.default
+
+    def __setitem__(self, idx, value):
+        i, j = idx
+        if not self.inband(i, j):
+            raise IndexError(f"Cannot set out-of-band element [{i}, {j}].")
+        self.data[self.data_row(i, j), j] = value
+
+    def full(self) -> np.ndarray:
+        """Dense representation; out-of-band cells are zero, matching the
+        reference's `full` (bandedarrays.jl:160-168)."""
+        result = np.zeros(self.shape, dtype=self.dtype)
+        for j in range(self.ncols):
+            start, stop = self.row_range(j)
+            dstart, dstop = self.data_row_range(j)
+            result[start : stop + 1, j] = self.data[dstart : dstop + 1, j]
+        return result
+
+    def dense(self, default=None) -> np.ndarray:
+        """Dense representation with out-of-band cells set to `default`."""
+        if default is None:
+            default = self.default
+        result = np.full(self.shape, default, dtype=self.dtype)
+        for j in range(self.ncols):
+            start, stop = self.row_range(j)
+            dstart, dstop = self.data_row_range(j)
+            result[start : stop + 1, j] = self.data[dstart : dstop + 1, j]
+        return result
+
+    def flip(self) -> None:
+        """Reverse rows and columns in place: [i, j] -> [m-1-i, n-1-j]
+        (bandedarrays.jl:176-198)."""
+        n = ndatarows(self.nrows, self.ncols, self.bandwidth)
+        self.data[:n, : self.ncols] = self.data[:n, : self.ncols][::-1, ::-1]
+
+    def copy(self) -> "BandedArray":
+        out = BandedArray.__new__(BandedArray)
+        out.dtype = self.dtype
+        out.default = self.default
+        out.bandwidth = self.bandwidth
+        out._set_shape(self.shape)
+        out.data = self.data.copy()
+        return out

@@ -1,0 +1,38 @@
+from .constants import (
+    CODON_LENGTH,
+    BASES,
+    BASE_TO_INT,
+    INT_TO_BASE,
+    GAP_INT,
+    encode_seq,
+    decode_seq,
+)
+from .phred import (
+    MIN_PHRED,
+    MAX_PHRED,
+    p_to_phred,
+    phred_to_log_p,
+    phred_to_p,
+    cap_phreds,
+    normalize,
+)
+from .mathops import logsumexp10, summax
+
+__all__ = [
+    "CODON_LENGTH",
+    "BASES",
+    "BASE_TO_INT",
+    "INT_TO_BASE",
+    "GAP_INT",
+    "encode_seq",
+    "decode_seq",
+    "MIN_PHRED",
+    "MAX_PHRED",
+    "p_to_phred",
+    "phred_to_log_p",
+    "phred_to_p",
+    "cap_phreds",
+    "normalize",
+    "logsumexp10",
+    "summax",
+]

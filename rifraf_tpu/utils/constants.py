@@ -1,0 +1,43 @@
+"""Base-level constants and sequence encoding.
+
+TPU-native re-design of the reference's primitive layer
+(/root/reference/src/util.jl:1-5, src/types.jl): DNA sequences are int8 code
+arrays (A=0, C=1, G=2, T=3) so they can live on device; strings only exist at
+the I/O boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CODON_LENGTH = 3
+
+BASES = "ACGT"
+BASE_TO_INT = {"A": 0, "C": 1, "G": 2, "T": 3}
+INT_TO_BASE = np.array(list(BASES))
+
+# Code used for padding / gaps in int8 sequence arrays.
+GAP_INT = -1
+
+
+def encode_seq(seq: str) -> np.ndarray:
+    """Encode a DNA string as an int8 code array (A=0, C=1, G=2, T=3)."""
+    if len(seq) == 0:
+        return np.zeros(0, dtype=np.int8)
+    arr = np.frombuffer(seq.upper().encode("ascii"), dtype=np.uint8)
+    out = np.full(arr.shape, GAP_INT, dtype=np.int8)
+    for base, code in BASE_TO_INT.items():
+        out[arr == ord(base)] = code
+    if (out == GAP_INT).any():
+        bad = seq[int(np.argmax(out == GAP_INT))]
+        raise ValueError(f"invalid DNA character: {bad!r}")
+    return out
+
+
+def decode_seq(codes: np.ndarray) -> str:
+    """Decode an int8 code array back to a DNA string (ignores padding)."""
+    codes = np.asarray(codes)
+    codes = codes[codes >= 0]
+    if codes.size == 0:
+        return ""
+    return "".join(INT_TO_BASE[codes])

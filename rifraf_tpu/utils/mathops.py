@@ -1,0 +1,28 @@
+"""Log-space math helpers (reference: /root/reference/src/util.jl:24-48)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def logsumexp10(x) -> float:
+    """LogSumExp in base 10 (util.jl:28-38)."""
+    x = np.asarray(x, dtype=np.float64)
+    if x.size == 0:
+        return -np.inf
+    u = np.max(x)
+    if np.isinf(u):
+        return float("nan") if np.isnan(x).any() else float(u)
+    return float(np.log10(np.sum(np.power(10.0, x - u))) + u)
+
+
+def summax(a, b) -> float:
+    """Max-plus inner product: max_i(a[i] + b[i]) (util.jl:40-48).
+
+    Used to join a forward column with a backward column; the name is kept
+    for parity with the reference.
+    """
+    a = np.asarray(a)
+    b = np.asarray(b)
+    n = min(len(a), len(b))
+    return float(np.max(a[:n] + b[:n]))

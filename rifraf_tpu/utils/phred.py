@@ -1,0 +1,43 @@
+"""Phred <-> probability conversions.
+
+Mirrors the semantics of /root/reference/src/phred.jl: phred scores are
+integers, probabilities are in linear space, and log probabilities are
+base-10 (the whole framework works in log10 space).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MIN_PHRED = 1
+MAX_PHRED = ord("~") - 33  # 93
+
+
+def p_to_phred(p) -> np.ndarray:
+    """Convert error probability to PHRED score (phred.jl:5-11)."""
+    p = np.asarray(p, dtype=np.float64)
+    scores = np.minimum(np.round(-10.0 * np.log10(p)), MAX_PHRED)
+    return scores.astype(np.int8)
+
+
+def phred_to_log_p(x) -> np.ndarray:
+    """Convert PHRED score to log10 error probability (phred.jl:14-18)."""
+    return np.asarray(x, dtype=np.float64) / (-10.0)
+
+
+def phred_to_p(q) -> np.ndarray:
+    """Convert PHRED score to error probability (phred.jl:21-27)."""
+    return np.power(10.0, phred_to_log_p(q))
+
+
+def cap_phreds(phreds, max_phred: int) -> np.ndarray:
+    """Cap phred values at a maximum (phred.jl:36-41)."""
+    if max_phred < 1:
+        raise ValueError("max phred value must be positive")
+    return np.minimum(np.asarray(phreds), max_phred).astype(np.int8)
+
+
+def normalize(parts) -> np.ndarray:
+    """Normalize rates to probabilities (phred.jl:30-34)."""
+    parts = np.asarray(parts, dtype=np.float64)
+    return parts / parts.sum()

@@ -1,0 +1,244 @@
+"""Alignment-engine oracle tests, ported from /root/reference/test/test_align.jl.
+
+These exercise the numpy reference engine (rifraf_tpu.ops.align_np); the JAX
+kernels are tested for equivalence against this engine in test_align_jax.py.
+"""
+
+import numpy as np
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.ops import align_np as al
+from rifraf_tpu.utils import encode_seq, decode_seq
+
+
+def inv_log10(lp):
+    return np.log10(1.0 - 10.0**lp)
+
+
+def colmax(A, B, j):
+    """max_i(A[i,j] + B[i,j]) over the in-band overlap of column j."""
+    a_start, a_stop = A.row_range(j)
+    b_start, b_stop = B.row_range(j)
+    start = max(a_start, b_start)
+    stop = min(a_stop, b_stop)
+    if stop < start:
+        return -np.inf
+    acol = np.array([A[i, j] for i in range(start, stop + 1)])
+    bcol = np.array([B[i, j] for i in range(start, stop + 1)])
+    return np.max(acol + bcol)
+
+
+def check_all_cols(A, B, codon_moves: bool):
+    """The forward/backward consistency invariant (test_utils.jl:6-23):
+    for every column j, max_i(A[i,j] + B[i,j]) == A[end,end]. With codon
+    moves, every 3-column window must contain the correct score."""
+    expected = A[A.nrows - 1, A.ncols - 1]
+    assert np.isclose(expected, B[0, 0], atol=1e-6)
+    ncols = A.ncols
+    if codon_moves:
+        for j in range(ncols - 2):
+            best = max(colmax(A, B, jj) for jj in (j, j + 1, j + 2))
+            assert np.isclose(best, expected, atol=1e-6), f"cols {j}..{j+2}: {best} != {expected}"
+    else:
+        for j in range(ncols):
+            best = colmax(A, B, j)
+            assert np.isclose(best, expected, atol=1e-6), f"col {j}: {best} != {expected}"
+
+
+SCORES = Scores(-1.0, -1.0, -1.0, -np.inf, -np.inf)
+
+
+def make_pseq(seq, log_p, bandwidth, scores=SCORES):
+    return make_read_scores(seq, np.asarray(log_p, dtype=np.float64), bandwidth, scores)
+
+
+def test_perfect_forward():
+    lp = -3.0
+    match = inv_log10(lp)
+    pseq = make_pseq("AA", [lp, lp], 1)
+    A = al.forward(encode_seq("AA"), pseq)
+    expected = np.array(
+        [
+            [0.0, lp + SCORES.deletion, 0.0],
+            [lp + SCORES.insertion, match, match + lp + SCORES.deletion],
+            [0.0, match + lp + SCORES.insertion, 2 * match],
+        ]
+    )
+    np.testing.assert_allclose(A.full(), expected, atol=1e-9)
+
+    A2, _ = al.forward_moves(encode_seq("AA"), pseq)
+    np.testing.assert_allclose(A2.full(), A.full(), atol=1e-9)
+
+
+def test_perfect_backward():
+    lp = -3.0
+    match = inv_log10(lp)
+    pseq = make_pseq("AA", [lp, lp], 1)
+    B = al.backward(encode_seq("AA"), pseq)
+    expected = np.array(
+        [
+            [2 * match, match + lp + SCORES.insertion, 0.0],
+            [match + lp + SCORES.deletion, match, lp + SCORES.insertion],
+            [0.0, lp + SCORES.deletion, 0.0],
+        ]
+    )
+    np.testing.assert_allclose(B.full(), expected, atol=1e-9)
+
+
+def test_imperfect_forward():
+    lp = -3.0
+    match = inv_log10(lp)
+    pseq = make_pseq("AT", [lp, lp], 1)
+    A1 = al.forward(encode_seq("AA"), pseq)
+    B = al.backward(encode_seq("AA"), pseq)
+    check_all_cols(A1, B, False)
+    expected = np.array(
+        [
+            [0.0, lp + SCORES.deletion, 0.0],
+            [lp + SCORES.insertion, match, match + lp + SCORES.deletion],
+            [0.0, match + lp + SCORES.insertion, match + lp + SCORES.mismatch],
+        ]
+    )
+    np.testing.assert_allclose(A1.full(), expected, atol=0.01)
+    A2, _ = al.forward_moves(encode_seq("AA"), pseq)
+    np.testing.assert_allclose(A1.full(), A2.full(), atol=0.01)
+
+
+def test_imperfect_backward():
+    lp = -3.0
+    match = inv_log10(lp)
+    pseq = make_pseq("AT", [lp, lp], 1)
+    B = al.backward(encode_seq("AA"), pseq)
+    expected = np.array(
+        [
+            [lp + SCORES.mismatch + match, lp + SCORES.insertion + match, 0.0],
+            [2 * lp + SCORES.deletion + SCORES.mismatch, lp + SCORES.mismatch, lp + SCORES.insertion],
+            [0.0, lp + SCORES.deletion, 0.0],
+        ]
+    )
+    np.testing.assert_allclose(B.full(), expected, atol=0.01)
+
+
+def test_forward_backward_agreement_1():
+    # codon-enabled scores
+    local_scores = Scores.from_error_model(ErrorModel(2.0, 1.0, 1.0, 3.0, 3.0))
+    pseq = make_pseq("GTCG", [-1.2, -0.8, -0.7, -1.0], 5, local_scores)
+    t = encode_seq("TG")
+    A = al.forward(t, pseq)
+    B = al.backward(t, pseq)
+    check_all_cols(A, B, True)
+    A2, _ = al.forward_moves(t, pseq)
+    np.testing.assert_allclose(A.full(), A2.full(), atol=0.01)
+
+
+def test_forward_backward_agreement_2():
+    local_scores = Scores.from_error_model(ErrorModel(2.0, 1.0, 1.0, 3.0, 3.0))
+    pseq = make_pseq("GACAC", [-1.1, -1.1, -0.4, -1.0, -0.7], 5, local_scores)
+    t = encode_seq("GCACGGTC")
+    A = al.forward(t, pseq)
+    B = al.backward(t, pseq)
+    check_all_cols(A, B, True)
+
+
+def test_insertion_agreement():
+    log_p = [-5.0, -1.0, -6.0]
+    pseq = make_pseq("ATA", log_p, 10)
+    t = encode_seq("AA")
+    A = al.forward(t, pseq)
+    B = al.backward(t, pseq)
+    score = inv_log10(log_p[0]) + log_p[1] + SCORES.insertion + inv_log10(log_p[2])
+    assert np.isclose(A[A.nrows - 1, A.ncols - 1], score)
+    check_all_cols(A, B, False)
+
+
+def test_deletion_agreement_1():
+    log_p = [-5.0, -2.0, -1.0, -6.0]
+    pseq = make_pseq("GAAG", log_p, 10)
+    t = encode_seq("GATAG")
+    A = al.forward(t, pseq)
+    B = al.backward(t, pseq)
+    score = (
+        pseq.match_scores[0]
+        + pseq.match_scores[1]
+        + pseq.del_scores[2]
+        + pseq.match_scores[2]
+        + pseq.match_scores[3]
+    )
+    assert np.isclose(A[A.nrows - 1, A.ncols - 1], score)
+    check_all_cols(A, B, False)
+
+
+def test_deletion_agreement_2():
+    log_p = [-2.0, -3.0]
+    pseq = make_pseq("AA", log_p, 10)
+    t = encode_seq("ATA")
+    A = al.forward(t, pseq)
+    B = al.backward(t, pseq)
+    score = pseq.match_scores[0] + pseq.del_scores[1] + pseq.match_scores[1]
+    assert np.isclose(A[A.nrows - 1, A.ncols - 1], score)
+    check_all_cols(A, B, False)
+
+
+ALIGN_SCORES = Scores.from_error_model(ErrorModel(1.0, 1.0, 1.0, 0.0, 0.0))
+
+
+def aligned_to_str(arr):
+    return "".join("-" if c < 0 else "ACGT"[c] for c in arr)
+
+
+def test_align_1():
+    pseq = make_pseq("AAA", [-2.0, -3.0, -3.0], 10, ALIGN_SCORES)
+    moves = al.align_moves(encode_seq("ATAA"), pseq)
+    t, s = al.moves_to_aligned_seqs(moves, encode_seq("ATAA"), pseq.seq)
+    assert aligned_to_str(t) == "ATAA"
+    assert aligned_to_str(s) == "A-AA"
+
+
+def test_align_2():
+    pseq = make_pseq("AAACCCTT", [np.log10(0.1)] * 8, 10, ALIGN_SCORES)
+    moves = al.align_moves(encode_seq("AACCTT"), pseq)
+    t, s = al.moves_to_aligned_seqs(moves, encode_seq("AACCTT"), pseq.seq)
+    assert aligned_to_str(t)[-2:] == "TT"
+
+
+def test_moves_to_indices():
+    cases = [
+        ("AAA", "AAA", [1, 2, 3]),
+        ("AAA", "AAAT", [1, 2, 3]),
+        ("AAAT", "AAA", [1, 2, 3, 3]),
+        ("TAAA", "AAA", [0, 1, 2, 3]),
+    ]
+    for tstr, sstr, expected in cases:
+        pseq = make_pseq(sstr, [np.log10(0.1)] * len(sstr), 10, ALIGN_SCORES)
+        moves = al.align_moves(encode_seq(tstr), pseq)
+        indices = al.moves_to_indices(moves, len(tstr), len(sstr))
+        np.testing.assert_array_equal(indices, expected), (tstr, sstr)
+
+
+def test_align_and_skew():
+    ref_scores = Scores.from_error_model(ErrorModel(10.0, 1e-10, 1e-10, 1.0, 1.0))
+    consensus_errors = [-8.0, -8.0, -8.0, -1.0, -8.0, -10.0, -10.0]
+    consensus = make_pseq("CTGCCGA", consensus_errors, 10, ref_scores)
+    a, b = al.align(encode_seq("CGGCGATTT"), consensus, skew_matches=True)
+    assert aligned_to_str(a) == "CGG-CGATTT"
+    assert aligned_to_str(b) == "CTGCCGA---"
+
+
+def test_align_with_self():
+    seqstr = "AAAGGGTTTCCC"
+    errors = np.full(len(seqstr), 0.1)
+    errors[:6] = 0.3
+    errors[-4:] = 0.45
+    scores = Scores.from_error_model(ErrorModel(1.0, 10.0, 10.0, 0.0, 0.0))
+    rseq = make_pseq(seqstr, np.log10(errors), 3, scores)
+    a, b = al.align(encode_seq(seqstr), rseq)
+    np.testing.assert_array_equal(a, b)
+    assert aligned_to_str(a) == seqstr
+
+
+def test_edit_distance():
+    assert al.edit_distance(encode_seq("ACGT"), encode_seq("ACGT")) == 0
+    assert al.edit_distance(encode_seq("ACGT"), encode_seq("AGT")) == 1
+    assert al.edit_distance(encode_seq("ACGT"), encode_seq("ACCGT")) == 1
+    assert al.edit_distance(encode_seq("ACGT"), encode_seq("AAGT")) == 1

@@ -1,0 +1,163 @@
+"""BandedArray tests, ported (to 0-based indexing) from
+/root/reference/test/test_bandedarrays.jl."""
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.ops.banded_array import BandedArray, equal_ranges
+
+
+def test_inband():
+    m = BandedArray((13, 11), 5, dtype=np.int64)
+    assert m.inband(0, 0)
+    assert m.inband(7, 0)
+    assert not m.inband(8, 0)
+    assert m.inband(0, 5)
+    assert not m.inband(0, 6)
+
+
+def test_data_row():
+    m = BandedArray((3, 3), 1, dtype=np.int64)
+    assert m.data_row(0, 0) == 1
+    assert m.data_row(1, 0) == 2
+    assert m.data_row(0, 1) == 0
+    assert m.data_row(1, 1) == 1
+    assert m.data_row(2, 1) == 2
+    assert m.data_row(1, 2) == 0
+    assert m.data_row(2, 2) == 1
+
+    m = BandedArray((3, 5), 1, dtype=np.int64)
+    assert m.data_row(0, 0) == 3
+    assert m.data_row(2, 4) == 1
+
+    m = BandedArray((5, 3), 1, dtype=np.int64)
+    assert m.data_row(0, 0) == 1
+    assert m.data_row(4, 2) == 3
+
+
+def test_row_range():
+    m = BandedArray((3, 5), 1, dtype=np.int64)
+    assert m.row_range(0) == (0, 1)
+    assert m.row_range(1) == (0, 2)
+
+    m = BandedArray((5, 3), 1, dtype=np.int64)
+    assert m.row_range(0) == (0, 3)
+    assert m.row_range(1) == (0, 4)
+
+
+def test_data_row_range():
+    m = BandedArray((3, 5), 1, dtype=np.int64)
+    assert m.data_row_range(0) == (3, 4)
+    assert m.data_row_range(1) == (2, 4)
+
+    m = BandedArray((5, 3), 1, dtype=np.int64)
+    assert m.data_row_range(0) == (1, 4)
+    assert m.data_row_range(1) == (0, 4)
+
+
+def test_sparsecol():
+    m = BandedArray((5, 3), 1, dtype=np.int64)
+    m[0, 0] = 1
+    np.testing.assert_array_equal(m.sparsecol(0), [1, 0, 0, 0])
+
+
+def test_flip():
+    m = BandedArray((5, 3), 1, dtype=np.int64)
+    m[0, 0] = 1
+    m.flip()
+    assert m[4, 2] == 1
+
+
+def test_sym_band():
+    m = BandedArray((3, 3), 1, dtype=np.int64)
+    m.data[:] = 1
+    expected = np.ones((3, 3), dtype=np.int64)
+    expected[2, 0] = 0
+    expected[0, 2] = 0
+    np.testing.assert_array_equal(m.full(), expected)
+
+
+def test_wide():
+    m = BandedArray((3, 4), 1, dtype=np.int64)
+    m.data[:] = 1
+    expected = np.ones((3, 4), dtype=np.int64)
+    expected[0, -1] = 0
+    expected[-1, 0] = 0
+    np.testing.assert_array_equal(m.full(), expected)
+
+
+def test_wide_col():
+    m = BandedArray((3, 5), 1, dtype=np.int64)
+    m.data[:] = 1
+    np.testing.assert_array_equal(m.sparsecol(0), [1, 1])
+    for j in (1, 2, 3):
+        np.testing.assert_array_equal(m.sparsecol(j), [1, 1, 1])
+    np.testing.assert_array_equal(m.sparsecol(4), [1, 1])
+
+
+def test_tall():
+    m = BandedArray((4, 3), 1, dtype=np.int64)
+    m.data[:] = 1
+    expected = np.ones((4, 3), dtype=np.int64)
+    expected[0, -1] = 0
+    expected[-1, 0] = 0
+    np.testing.assert_array_equal(m.full(), expected)
+
+
+def test_tall_band():
+    m = BandedArray((5, 3), 1, dtype=np.int64)
+    m.data[:] = 1
+    expected = np.ones((5, 3), dtype=np.int64)
+    expected[4, 0] = 0
+    expected[0, 2] = 0
+    np.testing.assert_array_equal(m.full(), expected)
+
+
+def test_individual_setting():
+    m = BandedArray((3, 3), 1, dtype=np.int64)
+    m[0, 1] = 3
+    m[1, 0] = 5
+    expected = np.zeros((3, 3), dtype=np.int64)
+    expected[0, 1] = 3
+    expected[1, 0] = 5
+    np.testing.assert_array_equal(m.full(), expected)
+
+
+def test_set_entire_band():
+    m = BandedArray((3, 3), 1, dtype=np.int64)
+    for (i, j, v) in [(0, 0, 1), (1, 0, 1), (0, 1, 2), (1, 1, 2), (2, 1, 2), (1, 2, 3), (2, 2, 3)]:
+        m[i, j] = v
+    expected = np.zeros((3, 3), dtype=np.int64)
+    expected[0:2, 0] = 1
+    expected[0:3, 1] = 2
+    expected[1:3, 2] = 3
+    np.testing.assert_array_equal(m.full(), expected)
+
+
+def test_out_of_band_get_set():
+    m = BandedArray((13, 11), 5, default=-np.inf)
+    m[0, 0] = 1.0
+    assert m[0, 0] == 1.0
+    assert m[12, 0] == -np.inf
+    with pytest.raises(IndexError):
+        m[12, 0] = 1.0
+
+
+def test_resize():
+    m = BandedArray((5, 5), 1, dtype=np.int64)
+    old = m.data
+    m.resize((3, 3))
+    assert m.data is old  # resize down reuses storage
+    m.resize((5, 10))
+    assert m.data is not old
+    assert m.row_range(0) == (0, 1)
+    assert m.row_range(2) == (0, 3)
+    assert m.row_range(4) == (0, 4)
+    assert m.row_range(9) == (3, 4)
+
+
+def test_equal_ranges():
+    # 0-based inclusive row ranges; returns half-open index ranges
+    assert equal_ranges((2, 4), (3, 5)) == ((1, 3), (0, 2))
+    assert equal_ranges((0, 4), (0, 1)) == ((0, 2), (0, 2))
+    assert equal_ranges((0, 4), (3, 4)) == ((3, 5), (0, 2))

@@ -1,0 +1,180 @@
+"""Tests for L0-L2: phred conversions, error model, read score precompute.
+
+Oracles from /root/reference/test/test_rifrafsequences.jl and the reference
+source semantics.
+"""
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import (
+    batch_reads,
+    empty_read_scores,
+    make_read_scores,
+    read_scores_from_phreds,
+)
+from rifraf_tpu.utils import (
+    cap_phreds,
+    decode_seq,
+    encode_seq,
+    logsumexp10,
+    p_to_phred,
+    phred_to_log_p,
+    phred_to_p,
+    summax,
+)
+
+
+def test_encode_decode():
+    assert decode_seq(encode_seq("ACGT")) == "ACGT"
+    assert decode_seq(encode_seq("")) == ""
+    np.testing.assert_array_equal(encode_seq("AACGT"), [0, 0, 1, 2, 3])
+    with pytest.raises(ValueError):
+        encode_seq("ACGX")
+
+
+def test_phred_roundtrip():
+    phreds = np.array([1, 10, 30, 93], dtype=np.int8)
+    log_p = phred_to_log_p(phreds)
+    np.testing.assert_allclose(log_p, phreds / -10.0)
+    p = phred_to_p(phreds)
+    np.testing.assert_allclose(p, 10.0 ** (phreds / -10.0))
+    back = p_to_phred(p)
+    np.testing.assert_array_equal(back, phreds)
+
+
+def test_p_to_phred_caps():
+    assert p_to_phred(np.array([1e-30]))[0] == 93
+
+
+def test_cap_phreds():
+    np.testing.assert_array_equal(
+        cap_phreds(np.array([1, 50, 93], dtype=np.int8), 30), [1, 30, 30]
+    )
+    with pytest.raises(ValueError):
+        cap_phreds(np.array([1], dtype=np.int8), 0)
+
+
+def test_logsumexp10():
+    x = np.array([-1.0, -2.0, -3.0])
+    expected = np.log10(np.sum(10.0**x))
+    assert abs(logsumexp10(x) - expected) < 1e-12
+    assert logsumexp10([]) == -np.inf
+
+
+def test_summax():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([3.0, 1.0, 0.5])
+    assert summax(a, b) == 4.0
+    # uses min length, like the reference
+    assert summax(a[:2], b) == 4.0
+
+
+def test_error_model_normalize():
+    em = ErrorModel(8, 0, 0, 1, 1).normalize()
+    assert abs(em.mismatch - 0.8) < 1e-12
+    assert abs(em.codon_insertion - 0.1) < 1e-12
+
+
+def test_scores_from_error_model():
+    # codon indel extra penalty is 3x the single indel extra
+    # (errormodel.jl:75-80)
+    s = Scores.from_error_model(
+        ErrorModel(1.0, 1.0, 1.0, 1.0, 1.0), mismatch=-0.5, insertion=-1.0, deletion=-2.0
+    )
+    base = np.log10(0.2)
+    assert abs(s.mismatch - (base - 0.5)) < 1e-12
+    assert abs(s.insertion - (base - 1.0)) < 1e-12
+    assert abs(s.deletion - (base - 2.0)) < 1e-12
+    assert abs(s.codon_insertion - (base - 3.0)) < 1e-12
+    assert abs(s.codon_deletion - (base - 6.0)) < 1e-12
+
+
+def test_scores_no_codon():
+    s = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0))
+    assert s.codon_insertion == -np.inf
+    assert s.codon_deletion == -np.inf
+
+
+class TestReadScores:
+    # oracle: test_rifrafsequences.jl:15-28
+    def test_score_vectors(self):
+        error_log_p = np.array([-1.0, -2.0, -3.0, -4.0])
+        scores = Scores(-1.0, -2.0, -3.0, -4.0, -5.0)
+        rseq = make_read_scores("ACGT", error_log_p, 10, scores)
+
+        np.testing.assert_allclose(
+            rseq.match_scores, np.log10(1.0 - 10.0**error_log_p)
+        )
+        np.testing.assert_allclose(rseq.mismatch_scores, error_log_p + scores.mismatch)
+        np.testing.assert_allclose(rseq.ins_scores, error_log_p + scores.insertion)
+        np.testing.assert_allclose(
+            rseq.del_scores, np.array([-1.0, -1.0, -2.0, -3.0, -4.0]) + scores.deletion
+        )
+        np.testing.assert_allclose(
+            rseq.codon_ins_scores, np.array([-1.0, -2.0]) + scores.codon_insertion
+        )
+        np.testing.assert_allclose(
+            rseq.codon_del_scores,
+            np.array([-1.0, -1.0, -2.0, -3.0, -4.0]) + scores.codon_deletion,
+        )
+        assert abs(rseq.est_n_errors - np.sum(10.0**error_log_p)) < 1e-12
+
+    def test_empty(self):
+        scores = Scores(-1.0, -2.0, -3.0, -4.0, -5.0)
+        rseq = make_read_scores("", [], 10, scores)
+        assert len(rseq) == 0
+        assert len(empty_read_scores(scores)) == 0
+
+    def test_no_codon_scores(self):
+        scores = Scores(-1.0, -2.0, -3.0)
+        rseq = make_read_scores("ACGT", [-1.0, -2.0, -3.0, -4.0], 10, scores)
+        assert rseq.codon_ins_scores is None
+        assert rseq.codon_del_scores is None
+        assert not rseq.do_codon_moves
+
+    # oracle: test_rifrafsequences.jl:41-51
+    def test_update_scores(self):
+        scores = Scores(-1.0, -2.0, -3.0, -4.0, -5.0)
+        rseq = make_read_scores("ACGT", [-1.0, -2.0, -3.0, -4.0], 10, scores)
+        new_rseq = rseq.with_scores(Scores(-1.0, -1.0, -1.0, -1.0, -1.0))
+        np.testing.assert_allclose(new_rseq.ins_scores, new_rseq.mismatch_scores)
+
+    def test_phred_ctor(self):
+        scores = Scores(-1.0, -2.0, -3.0)
+        rseq = read_scores_from_phreds("ACGT", np.array([3, 50, 10, 70], dtype=np.int8), 10, scores)
+        np.testing.assert_allclose(rseq.error_log_p, np.array([3, 50, 10, 70]) / -10.0)
+
+    def test_validation(self):
+        scores = Scores(-1.0, -2.0, -3.0)
+        with pytest.raises(ValueError):
+            make_read_scores("ACGT", [-1.0, -2.0], 10, scores)
+        with pytest.raises(ValueError):
+            make_read_scores("ACGT", [-1.0, -2.0, -3.0, -np.inf], 10, scores)
+        with pytest.raises(ValueError):
+            make_read_scores("ACGT", [-1.0, -2.0, -3.0, 0.5], 10, scores)
+        with pytest.raises(ValueError):
+            make_read_scores("ACGT", [-1.0] * 4, 0, scores)
+
+    def test_reversed(self):
+        scores = Scores(-1.0, -2.0, -3.0, -4.0, -5.0)
+        rseq = make_read_scores("ACGT", [-1.0, -2.0, -3.0, -4.0], 10, scores)
+        rev = rseq.reversed()
+        np.testing.assert_array_equal(rev.seq, rseq.seq[::-1])
+        np.testing.assert_allclose(rev.del_scores, rseq.del_scores[::-1])
+        np.testing.assert_allclose(rev.codon_ins_scores, rseq.codon_ins_scores[::-1])
+
+
+def test_batch_reads():
+    scores = Scores(-1.0, -2.0, -3.0)
+    r1 = make_read_scores("ACGT", [-1.0, -2.0, -3.0, -4.0], 9, scores)
+    r2 = make_read_scores("AC", [-1.0, -2.0], 9, scores)
+    batch = batch_reads([r1, r2], dtype=np.float64)
+    assert batch.n_reads == 2
+    assert batch.max_len == 4
+    np.testing.assert_array_equal(batch.lengths, [4, 2])
+    np.testing.assert_array_equal(batch.seq[1], [0, 1, -1, -1])
+    np.testing.assert_allclose(batch.dels[1, :3], r2.del_scores)
+    # codon scores disabled -> -inf
+    assert np.all(np.isneginf(batch.cins))

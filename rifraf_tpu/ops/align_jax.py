@@ -1,0 +1,377 @@
+"""Batched banded alignment on device (JAX/XLA), the framework's hot kernel.
+
+TPU-native re-design of the reference banded Viterbi DP
+(/root/reference/src/align.jl:50-212) over the packed band layout
+(/root/reference/src/bandedarrays.jl:101-114).
+
+Design
+------
+The reference stores cell ``[i, j]`` at data row ``d = (i - j) + h_offset +
+bandwidth``. That layout is *diagonal-aligned*: a match move ``(i-1, j-1)``
+lives at the SAME data row ``d`` of the previous column, a delete move
+``(i, j-1)`` at ``d + 1`` of the previous column, and an insert move
+``(i-1, j)`` at ``d - 1`` of the same column. So a column update is:
+
+  1. ``cand[d] = max(prev[d] + match_score, prev[d+1] + del_score)`` —
+     fully vectorized over the band;
+  2. the insert chain ``F[d] = max(cand[d], F[d-1] + ins[d])`` — a max-plus
+     linear recurrence with the closed form
+     ``F = G + cummax(cand - G)`` where ``G = cumsum(ins)``,
+
+which makes the whole column fill a handful of vector ops of band height K.
+A ``lax.scan`` walks the columns; ``vmap`` batches over reads. No per-cell
+loops, no gathers in the inner loop, static shapes throughout — exactly what
+XLA wants. Codon moves (used only for the consensus-vs-reference alignment)
+are handled by the numpy oracle engine (align_np) on the host; the device
+kernel covers the read hot path, matching the reference where reads never
+carry codon scores (model.jl:893-896 requires len(ref) % 3 == 0 only for the
+reference, and codon scores come from ref_scores only).
+
+Shapes are bucketed: reads padded to ``L``, template padded to ``T``; the
+true lengths are dynamic scalars so consensus edits do NOT trigger
+recompilation. Out-of-band and padding cells hold ``-inf``.
+
+Trace codes match align.jl:4-12 / align_np.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.sequences import ReadBatch, ReadScores
+from .align_np import (
+    TRACE_DELETE,
+    TRACE_INSERT,
+    TRACE_MATCH,
+    TRACE_NONE,
+)
+from .banded_array import BandedArray, ndatarows
+
+NEG_INF = -jnp.inf
+
+
+class BandGeometry(NamedTuple):
+    """Per-read band frame (all dynamic scalars; shapes stay static).
+
+    ``d = (i - j) + offset`` maps cell (i, j) to data row d; the band
+    occupies data rows [0, nd) (bandedarrays.jl:44-53, 101-114).
+    """
+
+    slen: jnp.ndarray  # int32, true read length
+    tlen: jnp.ndarray  # int32, true template length
+    bandwidth: jnp.ndarray  # int32
+    offset: jnp.ndarray  # int32 = h_offset + bandwidth
+    nd: jnp.ndarray  # int32 = 2*bw + |slen - tlen| + 1 data rows used
+
+    @classmethod
+    def make(cls, slen, tlen, bandwidth):
+        slen = jnp.asarray(slen, jnp.int32)
+        tlen = jnp.broadcast_to(jnp.asarray(tlen, jnp.int32), slen.shape)
+        bandwidth = jnp.broadcast_to(jnp.asarray(bandwidth, jnp.int32), slen.shape)
+        h_offset = jnp.maximum(tlen - slen, 0)
+        nd = 2 * bandwidth + jnp.abs(slen - tlen) + 1
+        return cls(slen, tlen, bandwidth, h_offset + bandwidth, nd)
+
+
+def _column_cells(geom: BandGeometry, K: int, j):
+    """Row index i and validity for each data row d of column j."""
+    d = jnp.arange(K, dtype=jnp.int32)
+    i = d + j - geom.offset
+    valid = (i >= 0) & (i <= geom.slen) & (d < geom.nd) & (j <= geom.tlen)
+    return i, valid
+
+
+def _fill_column(cand, g, valid):
+    """Resolve the within-column insert chain F[d] = max(cand[d], F[d-1]+g[d]).
+
+    Closed form in the max-plus semiring: with G = cumsum(g),
+    F = G + cummax(cand - G). Valid because the in-band rows of a column are
+    contiguous in d, so no chain crosses an out-of-band gap.
+    """
+    G = jnp.cumsum(g)
+    F = G + jax.lax.cummax(cand - G)
+    return jnp.where(valid, F, NEG_INF)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "want_moves", "trim", "skew_matches")
+)
+def _forward_one(
+    t,  # int8 [T] padded template
+    seq,  # int8 [L] padded read
+    match,  # [L]
+    mismatch,  # [L]
+    ins,  # [L]
+    dels,  # [L + 1]
+    geom: BandGeometry,
+    K: int,
+    want_moves: bool = False,
+    trim: bool = False,
+    skew_matches: bool = False,
+):
+    """Banded forward DP for one read. Returns (band [K, T+1], moves, score).
+
+    Mirrors align.jl:114-194 (forward! / forward_moves!); `moves` is all
+    TRACE_NONE when want_moves=False.
+    """
+    T = t.shape[0]
+    L = seq.shape[0]
+    dtype = match.dtype
+    d = jnp.arange(K, dtype=jnp.int32)
+
+    def ins_chain(i, valid, j):
+        """Per-row insert-entry scores g[d] for column j (align.jl:66, 73-76)."""
+        si = jnp.clip(i - 1, 0, L - 1)
+        g = ins[si]
+        if trim:
+            g = jnp.where((j == 0) | (j == geom.tlen), jnp.zeros_like(g), g)
+        return jnp.where((i >= 1) & valid, g, jnp.zeros_like(g))
+
+    # column 0: cell (0, 0) = 0; rows below filled by the insert chain
+    i0, valid0 = _column_cells(geom, K, 0)
+    cand0 = jnp.where(i0 == 0, jnp.zeros((K,), dtype), NEG_INF)
+    g0 = ins_chain(i0, valid0, 0)
+    col0 = _fill_column(cand0, g0, valid0)
+    moves0 = jnp.where(
+        (i0 > 0) & (col0 > NEG_INF), TRACE_INSERT, TRACE_NONE
+    ).astype(jnp.int8)
+
+    skew = jnp.asarray(0.99 if skew_matches else 1.0, dtype)
+
+    def step(prev, j):
+        i, valid = _column_cells(geom, K, j)
+        tb = t[jnp.clip(j - 1, 0, T - 1)]
+        si = jnp.clip(i - 1, 0, L - 1)
+        sb = seq[si]
+        match_sc = jnp.where(sb == tb, match[si], mismatch[si] * skew)
+        # match from (i-1, j-1): same data row of the previous column
+        mcand = jnp.where(i >= 1, prev + match_sc, NEG_INF)
+        # delete from (i, j-1): data row d+1 of the previous column
+        prev_up = jnp.concatenate([prev[1:], jnp.full((1,), NEG_INF, dtype)])
+        dcand = prev_up + dels[jnp.clip(i, 0, L)]
+        cand = jnp.maximum(mcand, dcand)
+        g = ins_chain(i, valid, j)
+        col = _fill_column(cand, g, valid)
+        if want_moves:
+            shifted = jnp.concatenate([jnp.full((1,), NEG_INF, dtype), col[:-1]])
+            icand = shifted + g
+            # tie-break priority matches the reference helper call order:
+            # match > insert > delete (align.jl:78-86)
+            stacked = jnp.stack([mcand, icand, dcand])
+            move = jnp.array(
+                [TRACE_MATCH, TRACE_INSERT, TRACE_DELETE], jnp.int8
+            )[jnp.argmax(stacked, axis=0)]
+            move = jnp.where(valid & (col > NEG_INF), move, TRACE_NONE)
+        else:
+            move = jnp.zeros((K,), jnp.int8)
+        return col, (col, move)
+
+    _, (cols, mv) = jax.lax.scan(step, col0, jnp.arange(1, T + 1, dtype=jnp.int32))
+    band = jnp.concatenate([col0[None, :], cols], axis=0).T  # [K, T+1]
+    moves = jnp.concatenate([moves0[None, :], mv], axis=0).T
+    d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
+    score = band[d_end, geom.tlen]
+    return band, moves, score
+
+
+def _reverse_read(seq, match, mismatch, ins, dels, slen):
+    """Reversed per-base tables for the backward pass (align.jl:196-202);
+    reverses only the true-length prefix of each padded array."""
+    L = seq.shape[0]
+    k = jnp.arange(L)
+    idx = jnp.clip(slen - 1 - k, 0, L - 1)
+    live = k < slen
+    rseq = jnp.where(live, seq[idx], seq[k])
+    rmatch = jnp.where(live, match[idx], match[k])
+    rmismatch = jnp.where(live, mismatch[idx], mismatch[k])
+    rins = jnp.where(live, ins[idx], ins[k])
+    k1 = jnp.arange(L + 1)
+    idx1 = jnp.clip(slen - k1, 0, L)
+    rdels = jnp.where(k1 <= slen, dels[idx1], dels[k1])
+    return rseq, rmatch, rmismatch, rins, rdels
+
+
+def _reverse_template(t, tlen):
+    T = t.shape[0]
+    k = jnp.arange(T)
+    idx = jnp.clip(tlen - 1 - k, 0, T - 1)
+    return jnp.where(k < tlen, t[idx], t[k])
+
+
+@functools.partial(jax.jit, static_argnames=("K",))
+def _backward_one(t, seq, match, mismatch, ins, dels, geom: BandGeometry, K: int):
+    """Backward DP: forward on reversed sequences, then flip
+    (align.jl:196-202)."""
+    rt = _reverse_template(t, geom.tlen)
+    rseq, rmatch, rmismatch, rins, rdels = _reverse_read(
+        seq, match, mismatch, ins, dels, geom.slen
+    )
+    band, _, score = _forward_one(
+        rt, rseq, rmatch, rmismatch, rins, rdels, geom, K
+    )
+    T1 = band.shape[1]
+    flipped = band[::-1, ::-1]
+    flipped = jnp.roll(flipped, geom.nd - K, axis=0)
+    flipped = jnp.roll(flipped, geom.tlen + 1 - T1, axis=1)
+    # re-mask: rolled-in padding must not look like scores
+    j = jnp.arange(T1, dtype=jnp.int32)
+    dd = jnp.arange(K, dtype=jnp.int32)
+    i = dd[:, None] + j[None, :] - geom.offset
+    valid = (i >= 0) & (i <= geom.slen) & (dd[:, None] < geom.nd) & (
+        j[None, :] <= geom.tlen
+    )
+    flipped = jnp.where(valid, flipped, NEG_INF)
+    return flipped, score
+
+
+_forward_batch = jax.jit(
+    jax.vmap(_forward_one, in_axes=(None, 0, 0, 0, 0, 0, 0, None, None, None, None)),
+    static_argnames=("K", "want_moves", "trim", "skew_matches"),
+)
+_backward_batch = jax.jit(
+    jax.vmap(_backward_one, in_axes=(None, 0, 0, 0, 0, 0, 0, None)),
+    static_argnames=("K",),
+)
+
+
+def batch_geometry(batch: ReadBatch, tlen: int) -> BandGeometry:
+    return BandGeometry.make(batch.lengths, np.int32(tlen), batch.bandwidth)
+
+
+def band_height(batch: ReadBatch, tlen: int, margin: int = 0) -> int:
+    """Static band-buffer height K covering every read in the batch.
+
+    `margin` leaves headroom for adaptive bandwidth doubling without
+    recompilation (model.jl:643-672 doubles up to 2^5).
+    """
+    nd = 2 * (batch.bandwidth.astype(np.int64) + margin) + np.abs(
+        batch.lengths.astype(np.int64) - tlen
+    ) + 1
+    return int(nd.max())
+
+
+def forward_batch(
+    template: np.ndarray,
+    batch: ReadBatch,
+    tlen: Optional[int] = None,
+    K: Optional[int] = None,
+    want_moves: bool = False,
+    trim: bool = False,
+    skew_matches: bool = False,
+):
+    """Batched banded forward DP over all reads vs one (padded) template.
+
+    Returns (bands [N, K, T+1], moves [N, K, T+1] int8, scores [N],
+    geometry). `template` may be longer than `tlen` (bucket padding).
+    """
+    if tlen is None:
+        tlen = len(template)
+    if K is None:
+        K = band_height(batch, tlen)
+    geom = batch_geometry(batch, tlen)
+    bands, moves, scores = _forward_batch(
+        jnp.asarray(template, jnp.int8),
+        jnp.asarray(batch.seq),
+        jnp.asarray(batch.match),
+        jnp.asarray(batch.mismatch),
+        jnp.asarray(batch.ins),
+        jnp.asarray(batch.dels),
+        geom,
+        K,
+        want_moves,
+        trim,
+        skew_matches,
+    )
+    return bands, moves, scores, geom
+
+
+def backward_batch(
+    template: np.ndarray,
+    batch: ReadBatch,
+    tlen: Optional[int] = None,
+    K: Optional[int] = None,
+):
+    """Batched banded backward DP. Returns (bands [N, K, T+1], scores [N],
+    geometry); scores equal the forward totals (B[0, 0] == A[end, end])."""
+    if tlen is None:
+        tlen = len(template)
+    if K is None:
+        K = band_height(batch, tlen)
+    geom = batch_geometry(batch, tlen)
+    bands, scores = _backward_batch(
+        jnp.asarray(template, jnp.int8),
+        jnp.asarray(batch.seq),
+        jnp.asarray(batch.match),
+        jnp.asarray(batch.mismatch),
+        jnp.asarray(batch.ins),
+        jnp.asarray(batch.dels),
+        geom,
+        K,
+    )
+    return bands, scores, geom
+
+
+def traceback_batch(
+    moves: np.ndarray, geom: BandGeometry, max_steps: Optional[int] = None
+):
+    """Host-side traceback for every read, vectorized over the batch.
+
+    The move band is O(N*K*T) int8 — cheap to ship to host; the pointer
+    chase (align.jl:229-238) is inherently sequential per read, so all reads
+    step in lockstep here instead of running a device while_loop.
+    Returns a list of per-read move-code lists (reference order).
+    """
+    moves = np.asarray(moves)
+    slen = np.asarray(geom.slen)
+    tlen = np.asarray(geom.tlen)
+    offset = np.asarray(geom.offset)
+    N, K, _ = moves.shape
+    i = slen.copy().astype(np.int64)
+    if tlen.ndim == 0:
+        tl = np.full(N, int(tlen), dtype=np.int64)
+    else:
+        tl = tlen.astype(np.int64)
+    j = tl.copy()
+    out = [[] for _ in range(N)]
+    if max_steps is None:
+        max_steps = int((slen + tl).max()) + 1
+    for _ in range(max_steps):
+        active = (i > 0) | (j > 0)
+        if not active.any():
+            break
+        d = np.clip(i - j + offset, 0, K - 1)
+        m = moves[np.arange(N), d, np.clip(j, 0, moves.shape[2] - 1)]
+        m = np.where(active, m, TRACE_NONE)
+        for n in np.nonzero(active)[0]:
+            out[n].append(int(m[n]))
+        di = np.where(m == TRACE_MATCH, 1, 0) + np.where(m == TRACE_INSERT, 1, 0)
+        dj = np.where(m == TRACE_MATCH, 1, 0) + np.where(m == TRACE_DELETE, 1, 0)
+        bad = active & (m == TRACE_NONE)
+        if bad.any():
+            raise RuntimeError(f"traceback hit TRACE_NONE for reads {np.nonzero(bad)[0]}")
+        i = i - di * active
+        j = j - dj * active
+    return [ops[::-1] for ops in out]
+
+
+def band_to_banded_array(
+    band: np.ndarray,
+    slen: int,
+    tlen: int,
+    bandwidth: int,
+    default=-np.inf,
+    dtype=np.float64,
+) -> BandedArray:
+    """Convert one device band [K, T+1] back to a host BandedArray (tests /
+    host fallback interop)."""
+    band = np.asarray(band)
+    shape = (slen + 1, tlen + 1)
+    out = BandedArray(shape, bandwidth, default=default, dtype=dtype)
+    nd = ndatarows(shape[0], shape[1], bandwidth)
+    out.data[:nd, : tlen + 1] = band[:nd, : tlen + 1]
+    return out

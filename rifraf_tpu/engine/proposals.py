@@ -1,0 +1,121 @@
+"""Consensus edit proposals.
+
+Mirrors /root/reference/src/proposals.jl with 0-based coordinates:
+
+- ``Substitution(pos, base)`` replaces ``seq[pos]``.
+- ``Insertion(pos, base)`` inserts ``base`` before index ``pos``
+  (``pos == len(seq)`` appends). The reference's 1-based
+  ``Insertion(pos)`` "insert after pos" maps to the same ``pos`` here.
+- ``Deletion(pos)`` removes ``seq[pos]``.
+
+``anchor()`` recovers the reference's shared 1-based coordinate used for
+sorting, ambiguity, and minimum-distance filtering (proposals.jl:41-56,
+91, 104-115), so those behaviors match exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, NamedTuple, Sequence, Union
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Substitution:
+    pos: int
+    base: int
+
+
+@dataclass(frozen=True)
+class Insertion:
+    pos: int  # insert before this index; pos == len appends
+    base: int
+
+
+@dataclass(frozen=True)
+class Deletion:
+    pos: int
+
+
+Proposal = Union[Substitution, Insertion, Deletion]
+
+
+class ScoredProposal(NamedTuple):
+    proposal: Proposal
+    score: float
+
+
+class AmbiguousProposalsError(Exception):
+    pass
+
+
+def anchor(p: Proposal) -> int:
+    """The reference's shared 1-based position coordinate (proposals.jl)."""
+    return p.pos if isinstance(p, Insertion) else p.pos + 1
+
+
+def update_pos(p: Proposal, pos: int) -> Proposal:
+    """proposals.jl:17-27."""
+    if isinstance(p, Substitution):
+        return Substitution(pos, p.base)
+    if isinstance(p, Insertion):
+        return Insertion(pos, p.base)
+    return Deletion(pos)
+
+
+def are_ambiguous(proposals: Sequence[Proposal]) -> bool:
+    """At most one insertion per position and one substitution-or-deletion
+    per position (proposals.jl:41-56)."""
+    ins = [anchor(p) for p in proposals if isinstance(p, Insertion)]
+    other = [anchor(p) for p in proposals if not isinstance(p, Insertion)]
+    return len(set(ins)) != len(ins) or len(set(other)) != len(other)
+
+
+def apply_proposals(seq: np.ndarray, proposals: Sequence[Proposal]) -> np.ndarray:
+    """Apply a non-ambiguous proposal set in one pass (proposals.jl:80-102).
+
+    Deletions sort before insertions at the same anchor; an insertion
+    directly after a deletion knows not to re-emit the deleted base
+    (proposals.jl:63-69, 87-98).
+    """
+    if are_ambiguous(proposals):
+        raise AmbiguousProposalsError()
+    seq = np.asarray(seq, dtype=np.int8)
+    ordered = sorted(
+        proposals, key=lambda p: (anchor(p), 0 if isinstance(p, Deletion) else 1)
+    )
+    parts: List[np.ndarray] = []
+    n0 = 0
+    last_del_anchor = 0
+    for p in ordered:
+        a = anchor(p)
+        parts.append(seq[n0 : max(a - 1, 0)])
+        if isinstance(p, Substitution):
+            parts.append(np.array([p.base], dtype=np.int8))
+        elif isinstance(p, Insertion):
+            if a > 0 and last_del_anchor != a:
+                parts.append(np.array([seq[a - 1], p.base], dtype=np.int8))
+            else:
+                parts.append(np.array([p.base], dtype=np.int8))
+        else:
+            last_del_anchor = a
+        n0 = a
+    parts.append(seq[n0:])
+    return np.concatenate(parts) if parts else seq.copy()
+
+
+def choose_candidates(
+    candidates: Sequence[ScoredProposal], min_dist: int
+) -> List[ScoredProposal]:
+    """Greedily keep top-scoring proposals at least min_dist apart
+    (proposals.jl:104-115)."""
+    final: List[ScoredProposal] = []
+    posns: List[int] = []
+    for c in sorted(candidates, key=lambda c: c.score, reverse=True):
+        a = anchor(c.proposal)
+        if any(abs(a - p) < min_dist for p in posns):
+            continue
+        posns.append(a)
+        final.append(c)
+    return final

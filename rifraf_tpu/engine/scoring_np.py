@@ -1,0 +1,173 @@
+"""Host (numpy) proposal scoring: the O(bandwidth) rescoring trick.
+
+Mirrors /root/reference/src/model.jl:227-399. Given cached forward (A) and
+backward (B) banded matrices for a read-vs-consensus alignment, scores a
+single-base edit of the consensus without redoing the full alignment:
+
+- Deletion: join column `pos` of A with column `pos+1` of B via the max-plus
+  inner product (seq_score_deletion, model.jl:227-236).
+- Substitution/Insertion: recompute one new column after the last valid A
+  column, then join with the appropriate B column (score_nocodon,
+  model.jl:242-285).
+- With codon moves enabled (the consensus-vs-reference path), recompute
+  CODON_LENGTH+1 columns and take the best join over 3 B columns
+  (model.jl:302-383).
+
+This is the exactness oracle for the batched device scorer
+(rifraf_tpu.ops.proposal_jax) and the production path for the single
+reference sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..models.sequences import ReadScores
+from ..ops.align_np import update
+from ..ops.banded_array import BandedArray, equal_ranges
+from ..utils.constants import CODON_LENGTH, GAP_INT
+from .proposals import Deletion, Insertion, Proposal, Substitution
+
+
+def summax_ranges(acol, a_range, bcol, b_range) -> float:
+    """Max-plus inner product of two sub-columns over their common rows
+    (model.jl:229-236, util.jl:40-48)."""
+    (amin, amax), (bmin, bmax) = equal_ranges(a_range, b_range)
+    asub = acol[amin:amax]
+    bsub = bcol[bmin:bmax]
+    if len(asub) == 0:
+        return -np.inf
+    return float(np.max(asub + bsub))
+
+
+def seq_score_deletion(A: BandedArray, B: BandedArray, acol: int, bcol: int) -> float:
+    return summax_ranges(
+        A.sparsecol(acol), A.row_range(acol), B.sparsecol(bcol), B.row_range(bcol)
+    )
+
+
+# first B column to join, relative to acol (model.jl:238-240)
+BOFFSETS = {Substitution: 2, Insertion: 1, Deletion: 2}
+
+
+def _new_column(
+    A: BandedArray,
+    pseq: ReadScores,
+    newcols: np.ndarray,
+    acol: int,
+    col_idx: int,
+    logical_col: int,
+    t_base: int,
+) -> None:
+    """Fill newcols[:, col_idx] = logical column `logical_col` of the edited
+    alignment, reading columns <= acol from A and later ones from newcols
+    (model.jl:264-273, 345-355)."""
+    ncols = A.ncols
+    amin, amax = A.row_range(min(logical_col, ncols - 1))
+    for i in range(amin, amax + 1):
+        seq_base = pseq.seq[i - 1] if i > 0 else GAP_INT
+        score, _ = update(
+            A, i, logical_col, seq_base, t_base, pseq, newcols=newcols, acol=acol
+        )
+        newcols[i, col_idx] = score
+
+
+def score_nocodon(
+    proposal: Proposal,
+    A: BandedArray,
+    B: BandedArray,
+    pseq: ReadScores,
+    newcols: Optional[np.ndarray] = None,
+) -> float:
+    """model.jl:242-285 (0-based columns; see engine.proposals for the
+    coordinate mapping)."""
+    if A.nrows != len(pseq) + 1:
+        raise ValueError("wrong size array")
+    if isinstance(proposal, Deletion):
+        return seq_score_deletion(A, B, proposal.pos, proposal.pos + 1)
+    if newcols is None:
+        newcols = np.full((A.nrows, CODON_LENGTH + 1), -np.inf)
+    nrows, ncols = A.shape
+    acol = proposal.pos
+    new_acol = acol + 1
+    _new_column(A, pseq, newcols, acol, 0, new_acol, proposal.base)
+
+    imin, imax = A.row_range(min(new_acol, ncols - 1))
+    acol_vals = newcols[imin : imax + 1, 0]
+    bj = proposal.pos + 1 if isinstance(proposal, Substitution) else proposal.pos
+    score = summax_ranges(acol_vals, (imin, imax), B.sparsecol(bj), B.row_range(bj))
+    if score == -np.inf:
+        raise RuntimeError("failed to compute a valid score")
+    return score
+
+
+def score_proposal(
+    proposal: Proposal,
+    A: BandedArray,
+    B: BandedArray,
+    consensus: np.ndarray,
+    pseq: ReadScores,
+    newcols: Optional[np.ndarray] = None,
+) -> float:
+    """Score a proposal against one read using cached A/B (model.jl:302-383).
+
+    Exactness invariant (tested): equals the full realignment score of the
+    edited consensus (test_model.jl:39-153).
+    """
+    if not pseq.do_codon_moves:
+        return score_nocodon(proposal, A, B, pseq, newcols)
+
+    nrows, ncols = A.shape
+    # last valid column of A: 0-based col index == number of consensus
+    # prefix bases unaffected by the edit
+    acol = proposal.pos  # same for all three types (see scoring notes)
+    # first/last B columns to join (model.jl:310-314), 0-based
+    first_bcol = acol + BOFFSETS[type(proposal)]
+    last_bcol = first_bcol + CODON_LENGTH - 1
+
+    if isinstance(proposal, Deletion) and acol == ncols - 2:
+        # suffix deletion needs no recomputation (model.jl:316-319)
+        return float(A[nrows - 1, ncols - 2])
+
+    just_a = last_bcol >= ncols - 1
+    n_after = CODON_LENGTH if not just_a else len(consensus) - proposal.pos - (
+        0 if isinstance(proposal, Insertion) else 1
+    )
+    n_new_bases = 0 if isinstance(proposal, Deletion) else 1
+    if n_new_bases == 0 and n_after == 0:
+        raise RuntimeError("no new columns need to be recomputed")
+    n_new = n_new_bases + n_after
+
+    # consensus bases for the recomputed columns (model.jl:287-300)
+    prefix = (
+        [proposal.base]
+        if isinstance(proposal, (Substitution, Insertion))
+        else []
+    )
+    next_pos = proposal.pos + (0 if isinstance(proposal, Insertion) else 1)
+    suffix = list(consensus[next_pos : next_pos + n_after])
+    sub_consensus = prefix + suffix
+
+    if newcols is None or newcols.shape[1] < n_new:
+        newcols = np.full((nrows, max(n_new, CODON_LENGTH + 1)), -np.inf)
+    for j in range(n_new):
+        _new_column(A, pseq, newcols, acol, j, acol + j + 1, sub_consensus[j])
+
+    if just_a:
+        return float(newcols[nrows - 1, n_new - 1])
+
+    best = -np.inf
+    for j in range(CODON_LENGTH):
+        new_j = n_new - CODON_LENGTH + j
+        imin, imax = A.row_range(min(acol + new_j + 1, ncols - 1))
+        acol_vals = newcols[imin : imax + 1, new_j]
+        bj = first_bcol + j
+        score = summax_ranges(
+            acol_vals, (imin, imax), B.sparsecol(bj), B.row_range(bj)
+        )
+        best = max(best, score)
+    if best == -np.inf:
+        raise RuntimeError("failed to compute a valid score")
+    return best

@@ -1,0 +1,159 @@
+"""Batched proposal scoring on device: every proposal x every read, one launch.
+
+TPU-native version of the O(bandwidth) rescoring trick
+(/root/reference/src/model.jl:227-285). Where the reference loops proposals
+and reads on the host, here the whole candidate set is scored as one
+[K x P] x N vectorized program:
+
+- Deletion(pos): max-plus join of A[:, pos] with B[:, pos+1]; in the
+  diagonal-aligned band frame the B column is shifted one data row down.
+- Substitution/Insertion: one new band column computed from A[:, pos]
+  (match = same/previous data row, delete = next data row, insert chain =
+  the same closed-form max-plus scan as the forward kernel), joined with
+  B[:, pos+1] / B[:, pos].
+
+vmapped over reads; proposals dimension is vectorized directly. Codon moves
+(consensus-vs-reference only) stay on the host oracle
+(rifraf_tpu.engine.scoring_np).
+
+Proposal encoding: ptype 0=substitution, 1=insertion, 2=deletion
+(engine.proposals' 0-based coordinates).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.proposals import Deletion, Insertion, Proposal, Substitution
+from ..models.sequences import ReadBatch
+from .align_jax import BandGeometry
+
+NEG_INF = -jnp.inf
+
+PTYPE_SUB = 0
+PTYPE_INS = 1
+PTYPE_DEL = 2
+
+
+def encode_proposals(proposals) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack a proposal list into (ptype, pos, base) int arrays."""
+    P = len(proposals)
+    ptype = np.zeros(P, dtype=np.int32)
+    pos = np.zeros(P, dtype=np.int32)
+    base = np.zeros(P, dtype=np.int8)
+    for k, p in enumerate(proposals):
+        pos[k] = p.pos
+        if isinstance(p, Substitution):
+            ptype[k] = PTYPE_SUB
+            base[k] = p.base
+        elif isinstance(p, Insertion):
+            ptype[k] = PTYPE_INS
+            base[k] = p.base
+        else:
+            ptype[k] = PTYPE_DEL
+    return ptype, pos, base
+
+
+def _score_one_read(
+    A,  # [K, T+1]
+    B,  # [K, T+1]
+    seq,  # [L]
+    match,  # [L]
+    mismatch,  # [L]
+    ins,  # [L]
+    dels,  # [L+1]
+    geom: BandGeometry,  # scalars for this read
+    ptype,  # [P]
+    ppos,  # [P]
+    pbase,  # [P]
+):
+    K, _ = A.shape
+    L = seq.shape[0]
+    dtype = A.dtype
+    slen, tlen = geom.slen, geom.tlen
+    off = geom.offset
+    v_off = jnp.maximum(slen - tlen, 0)
+
+    d = jnp.arange(K, dtype=jnp.int32)[:, None]  # [K, 1]
+    pos = ppos[None, :]  # [1, P]
+    is_sub = (ptype == PTYPE_SUB)[None, :]
+    is_del = ptype == PTYPE_DEL
+
+    # --- deletion: join A[:, pos] with B[:, pos+1] one data row down ---
+    a_del = jnp.take(A, ppos, axis=1)  # [K, P]
+    b_del = jnp.take(B, jnp.minimum(ppos + 1, tlen), axis=1)
+    b_shift = jnp.concatenate([jnp.full((1, b_del.shape[1]), NEG_INF, dtype), b_del[:-1]])
+    del_score = jnp.max(a_del + b_shift, axis=0)
+
+    # --- substitution / insertion: one new band column ---
+    f = pos + jnp.where(is_sub, 1, 0)  # frame column of the new column
+    i = d + f - off  # true row index per data row [K, P]
+    jc = jnp.minimum(pos + 1, tlen)  # row-range column (model.jl:263)
+    rmin = jnp.maximum(0, jc - off)
+    rmax = jnp.minimum(jc + v_off + geom.bandwidth, slen)
+    valid = (i >= rmin) & (i <= rmax)
+
+    acol = a_del  # A[:, pos], reused
+    acol_up = jnp.concatenate([acol[1:], jnp.full((1, acol.shape[1]), NEG_INF, dtype)])
+    acol_dn = jnp.concatenate([jnp.full((1, acol.shape[1]), NEG_INF, dtype), acol[:-1]])
+    m_src = jnp.where(is_sub, acol, acol_dn)
+    d_src = jnp.where(is_sub, acol_up, acol)
+
+    si = jnp.clip(i - 1, 0, L - 1)
+    sb = seq[si]
+    msc = jnp.where(sb == pbase[None, :], match[si], mismatch[si])
+    mcand = jnp.where(i >= 1, m_src + msc, NEG_INF)
+    dcand = d_src + dels[jnp.clip(i, 0, L)]
+    cand = jnp.where(valid, jnp.maximum(mcand, dcand), NEG_INF)
+    g = jnp.where((i >= 1) & valid, ins[si], jnp.zeros_like(msc))
+    G = jnp.cumsum(g, axis=0)
+    NC = G + jax.lax.cummax(cand - G, axis=0)
+    NC = jnp.where(valid, NC, NEG_INF)
+
+    bj = jnp.where(ptype == PTYPE_SUB, ppos + 1, ppos)
+    bcol = jnp.take(B, jnp.minimum(bj, tlen), axis=1)
+    subins_score = jnp.max(NC + bcol, axis=0)
+
+    return jnp.where(is_del, del_score, subins_score)
+
+
+_score_batch = jax.jit(
+    jax.vmap(
+        _score_one_read,
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, None),
+    )
+)
+
+
+def score_proposals_batch(
+    A_bands,
+    B_bands,
+    batch: ReadBatch,
+    geom: BandGeometry,
+    proposals,
+):
+    """Score every proposal against every read. Returns [N, P] scores.
+
+    The driver sums over reads (and adds the host-scored reference term) to
+    rank candidates; keeping the read axis separate lets a sharded batch
+    `psum` partial sums across chips.
+    """
+    ptype, pos, base = encode_proposals(proposals)
+    return _score_batch(
+        A_bands,
+        B_bands,
+        jnp.asarray(batch.seq),
+        jnp.asarray(batch.match),
+        jnp.asarray(batch.mismatch),
+        jnp.asarray(batch.ins),
+        jnp.asarray(batch.dels),
+        geom,
+        jnp.asarray(ptype),
+        jnp.asarray(pos),
+        jnp.asarray(base),
+    )

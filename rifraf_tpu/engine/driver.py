@@ -1,0 +1,627 @@
+"""The RIFRAF consensus driver: greedy hill-climbing over proposal stages.
+
+Mirrors /root/reference/src/model.jl:564-1316 — the INIT -> FRAME -> REFINE
+-> SCORE state machine, stochastic read batching, reference penalty
+escalation, convergence logic, and quality estimation — re-architected so
+that all O(reads x length x bandwidth) work happens in the batched device
+kernels (engine.realign), while the branchy, data-dependent control flow
+stays on the host exactly where the reference keeps it.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..models.errormodel import Scores
+from ..models.sequences import ReadScores, make_read_scores
+from ..ops import align_np
+from ..utils.constants import CODON_LENGTH
+from ..utils.mathops import logsumexp10
+from ..utils.phred import phred_to_log_p, phred_to_p
+from .generate import (
+    all_proposals,
+    alignment_proposals,
+    has_single_indels,
+    single_indel_proposals,
+)
+from .params import RifrafParams, Stage, check_params, next_stage
+from .proposals import (
+    Deletion,
+    Insertion,
+    Proposal,
+    ScoredProposal,
+    Substitution,
+    apply_proposals,
+    choose_candidates,
+)
+from .realign import BatchAligner, RefAligner
+
+
+@dataclass
+class EstimatedProbs:
+    """Per-base consensus error probabilities (model.jl:12-24)."""
+
+    sub: np.ndarray  # [len, 4]
+    dele: np.ndarray  # [len]
+    ins: np.ndarray  # [len + 1, 4]
+
+
+@dataclass
+class RifrafState:
+    """Mutable run state (model.jl:166-193)."""
+
+    consensus: np.ndarray
+    reference: Optional[ReadScores]
+    ref_scores: Scores
+    sequences: List[ReadScores]
+    batch_fixed_size: int
+    batch_size: int
+    base_batch_size: int
+    score: float = -np.inf
+    ref_error_rate: float = -np.inf
+    n_ref_indel_mults: int = 0
+    batch_seqs: List[ReadScores] = field(default_factory=list)
+    realign_As: bool = True
+    realign_Bs: bool = True
+    penalties_increased: bool = False
+    stage: Stage = Stage.INIT
+    stage_iterations: np.ndarray = field(
+        default_factory=lambda: np.zeros(len(Stage), dtype=int)
+    )
+    batch_randomness: float = 0.9
+    converged: bool = False
+    # device/host alignment engines (the As/Bs/Amoves caches)
+    aligner: Optional[BatchAligner] = None
+    ref_aligner: Optional[RefAligner] = None
+
+
+@dataclass
+class RifrafResult:
+    """model.jl:195-225."""
+
+    consensus: np.ndarray
+    params: RifrafParams
+    state: RifrafState
+    consensus_stages: List[List[np.ndarray]]
+    error_probs: Optional[EstimatedProbs] = None
+    aln_error_probs: Optional[np.ndarray] = None
+
+
+def _log(params: RifrafParams, level: int, msg: str) -> None:
+    if params.verbose >= level:
+        print(msg, file=sys.stderr)
+
+
+def initial_state(
+    consensus: Optional[np.ndarray],
+    sequences: List[ReadScores],
+    reference: Optional[np.ndarray],
+    params: RifrafParams,
+) -> RifrafState:
+    """model.jl:564-615."""
+    batch_size = params.batch_size if params.batch_size > 1 else len(sequences)
+    batch_size = min(batch_size, len(sequences))
+    batch_fixed_size = min(params.batch_fixed_size, len(sequences))
+
+    if consensus is None or len(consensus) == 0:
+        idx = int(
+            np.argmax([logsumexp10(s.match_scores) for s in sequences])
+        )
+        consensus = sequences[idx].seq.copy()
+
+    refseq = None
+    if reference is not None and len(reference) > 0:
+        # placeholder error rate until FRAME estimates the real one
+        ref_error_log_p = np.full(len(reference), 0.0)
+        refseq = ReadScores(
+            seq=np.asarray(reference, dtype=np.int8),
+            error_log_p=ref_error_log_p,
+            est_n_errors=float(len(reference)),
+            match_scores=np.zeros(len(reference)),
+            mismatch_scores=np.zeros(len(reference)),
+            ins_scores=np.zeros(len(reference)),
+            del_scores=np.zeros(len(reference) + 1),
+            codon_ins_scores=None,
+            codon_del_scores=None,
+            bandwidth=params.bandwidth,
+            scores=params.ref_scores,
+        )
+
+    return RifrafState(
+        consensus=np.asarray(consensus, dtype=np.int8),
+        reference=refseq,
+        ref_scores=params.ref_scores,
+        sequences=sequences,
+        batch_fixed_size=batch_fixed_size,
+        batch_size=batch_size,
+        base_batch_size=batch_size,
+        batch_randomness=params.batch_randomness,
+    )
+
+
+def use_ref(state: RifrafState, use_ref_for_qvs: bool) -> bool:
+    """model.jl:617-628."""
+    if state.reference is None or len(state.reference) == 0:
+        return False
+    if state.stage == Stage.FRAME:
+        return True
+    if state.stage == Stage.SCORE and use_ref_for_qvs:
+        return True
+    return False
+
+
+def reweight(wv: np.ndarray, n: int, randomness: float) -> np.ndarray:
+    """Interpolate between top-n / error-proportional / uniform weights
+    (model.jl:1017-1036)."""
+    if randomness < 0.0 or randomness > 1.0:
+        raise ValueError("randomness must be between 0.0 and 1.0")
+    wv = wv / wv.sum()
+    if randomness > 0.5:
+        weight = (randomness - 0.5) * 2.0
+        endpoint = np.full(len(wv), 1.0 / len(wv))
+    elif randomness < 0.5:
+        weight = 1.0 - randomness * 2.0
+        endpoint = np.zeros(len(wv))
+        indices = np.argsort(wv)[::-1][:n]
+        endpoint[indices] = 1.0 / n
+    else:
+        return wv
+    return weight * endpoint + (1.0 - weight) * wv
+
+
+def resample(state: RifrafState, params: RifrafParams, rng: np.random.Generator) -> None:
+    """Select the working batch of reads (resample!, model.jl:1038-1066)."""
+    err_weights = np.array([s.est_n_errors for s in state.sequences])
+    if state.stage in (Stage.INIT, Stage.FRAME) and params.batch_fixed:
+        indices = np.argsort(err_weights, kind="stable")[: state.batch_fixed_size]
+        state.batch_seqs = [state.sequences[i] for i in indices]
+        _log(params, 2, "    kept fixed batch")
+        return
+    wv = reweight(
+        1.0 - err_weights / err_weights.sum(), state.batch_size, state.batch_randomness
+    )
+    if state.batch_size < len(state.sequences):
+        wv = np.maximum(wv, 0.0)
+        wv = wv / wv.sum()
+        indices = rng.choice(
+            len(state.sequences), size=state.batch_size, replace=False, p=wv
+        )
+        state.batch_seqs = [state.sequences[i] for i in indices]
+        state.realign_As = True
+        _log(params, 2, f"    sampled {state.batch_size} new sequences")
+    else:
+        state.batch_seqs = list(state.sequences)
+        _log(params, 2, "    sampled all sequences")
+
+
+def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
+    """realign! + rescore! (model.jl:630-719), batched on device."""
+    if state.aligner is None or state.aligner.reads is not state.batch_seqs:
+        if state.aligner is not None:
+            state.aligner.export_bandwidths()
+        if state.aligner is None:
+            state.aligner = BatchAligner(
+                state.batch_seqs, dtype=params.dtype, len_bucket=params.len_bucket
+            )
+        else:
+            state.aligner.set_batch(state.batch_seqs)
+        state.realign_As = True
+        state.realign_Bs = True
+    _log(params, 2, f"    realigning As={state.realign_As} Bs={state.realign_Bs}")
+    state.aligner.realign(
+        state.consensus,
+        params.bandwidth_pvalue,
+        realign_As=state.realign_As,
+        realign_Bs=state.realign_Bs,
+    )
+    uref = use_ref(state, params.use_ref_for_qvs)
+    if uref:
+        if state.ref_aligner is None:
+            state.ref_aligner = RefAligner()
+        state.ref_aligner.realign(
+            state.consensus,
+            state.reference,
+            params.bandwidth_pvalue,
+            realign_As=True,
+            realign_Bs=True,
+        )
+    state.score = state.aligner.total_score()
+    if uref:
+        state.score += state.ref_aligner.score()
+
+
+def check_score(state: RifrafState, params: RifrafParams, old_score: float,
+                rng: np.random.Generator) -> bool:
+    """Grow the batch if the score regressed too much (model.jl:1074-1114).
+    Returns False if the stage should end."""
+    _log(params, 2, f"    score: {state.score}")
+    cur_iters = state.stage_iterations[int(state.stage) - 1]
+    if (
+        not state.penalties_increased
+        and state.batch_size == len(state.sequences)
+        and cur_iters > 1
+    ):
+        if state.score < old_score:
+            _log(params, 2, "    WARNING: not using batches, but score decreased.")
+        elif state.score == old_score:
+            _log(params, 2, "    score did not change. ending stage.")
+            return False
+    if (
+        old_score != -np.inf
+        and (state.score - old_score) / old_score > params.batch_threshold
+        and not state.penalties_increased
+        and state.batch_size < len(state.sequences)
+        and cur_iters > 1
+    ):
+        state.batch_size = min(
+            state.batch_size + state.base_batch_size, len(state.sequences)
+        )
+        _log(params, 2, f"    NOTE: increased batch size to {state.batch_size}.")
+        resample(state, params, rng)
+        state.realign_As = True
+        state.realign_Bs = True
+        realign_rescore(state, params)
+        _log(params, 2, f"    new score: {state.score}")
+    return True
+
+
+def get_candidates(
+    state: RifrafState,
+    params: RifrafParams,
+    indel_seeds: Sequence[Proposal] = (),
+) -> List[ScoredProposal]:
+    """Generate and score all proposals; keep the improving ones
+    (model.jl:499-526). Reads are scored in one device launch; the
+    reference term is host-scored (codon moves)."""
+    uref = state.stage == Stage.FRAME
+
+    if state.stage in (Stage.INIT, Stage.REFINE) and params.do_alignment_proposals:
+        do_indels = state.stage == Stage.INIT
+        proposals = alignment_proposals(
+            state.aligner.tracebacks,
+            state.consensus,
+            [r.seq for r in state.batch_seqs],
+            do_indels,
+        )
+    else:
+        proposals = all_proposals(
+            state.stage, state.consensus, params.indel_correction_only, indel_seeds
+        )
+    if not proposals:
+        return []
+    scores = state.aligner.score_proposals(proposals)
+    if uref:
+        scores = scores + state.ref_aligner.score_proposals(
+            proposals, state.consensus, state.reference
+        )
+    return [
+        ScoredProposal(p, float(s))
+        for p, s in zip(proposals, scores)
+        if s > state.score
+    ]
+
+
+def handle_candidates(
+    candidates: List[ScoredProposal], state: RifrafState, params: RifrafParams
+) -> None:
+    """Apply the best compatible candidates, with single-best rollback
+    (model.jl:898-935)."""
+    old_consensus = state.consensus
+    chosen = choose_candidates(candidates, params.min_dist)
+    _log(params, 2,
+         f"    found {len(candidates)} candidates; filtered to {len(chosen)}")
+    state.consensus = apply_proposals(
+        old_consensus, [c.proposal for c in chosen]
+    )
+    state.realign_As = True
+    state.realign_Bs = False
+    realign_rescore(state, params)
+    if len(chosen) > 1 and (
+        state.score < chosen[0].score or np.isclose(state.score, chosen[0].score)
+    ):
+        _log(params, 2, "    rejecting multiple candidates in favor of best")
+        chosen = chosen[:1]
+        state.consensus = apply_proposals(
+            old_consensus, [c.proposal for c in chosen]
+        )
+    else:
+        state.realign_As = False
+    state.realign_Bs = True
+
+
+def finish_stage(state: RifrafState, params: RifrafParams) -> None:
+    """Stage transitions / penalty escalation / convergence
+    (model.jl:937-995)."""
+    _log(params, 2, f"    no candidates found in {state.stage.name}.")
+    if state.stage == Stage.INIT:
+        if state.reference is None or not params.do_frame:
+            state.converged = True
+        else:
+            state.stage = Stage.FRAME
+            edit_dist = align_np.edit_distance(state.consensus, state.reference.seq)
+            ref_error_rate = edit_dist / max(
+                len(state.reference), len(state.consensus)
+            )
+            ref_error_rate *= params.ref_error_mult
+            # needs to be < 0.5, otherwise matches aren't rewarded at all
+            state.ref_error_rate = min(max(ref_error_rate, 1e-10), 0.5)
+            ref_error_log_p = np.full(
+                len(state.reference), np.log10(state.ref_error_rate)
+            )
+            state.reference = make_read_scores(
+                state.reference.seq, ref_error_log_p, params.bandwidth,
+                state.ref_scores,
+            )
+            if not has_single_indels(state.consensus, state.reference):
+                state.converged = True
+    elif state.stage == Stage.FRAME:
+        if not has_single_indels(state.consensus, state.reference):
+            state.stage = Stage.REFINE
+        elif state.n_ref_indel_mults == params.max_ref_indel_mults:
+            _log(params, 2,
+                 "    NOTE: alignment had single indels but reached penalty limit.")
+            state.stage = Stage.REFINE
+        else:
+            state.penalties_increased = True
+            state.n_ref_indel_mults += 1
+            mult = params.ref_indel_mult ** state.n_ref_indel_mults
+            state.ref_scores = Scores(
+                mismatch=state.ref_scores.mismatch,
+                insertion=state.ref_scores.insertion * mult,
+                deletion=state.ref_scores.deletion * mult,
+                codon_insertion=state.ref_scores.codon_insertion,
+                codon_deletion=state.ref_scores.codon_deletion,
+            )
+            state.reference = state.reference.with_scores(state.ref_scores)
+            _log(params, 2,
+                 "    NOTE: alignment to reference had single indels. "
+                 "increasing penalty.")
+    elif state.stage == Stage.REFINE:
+        state.converged = True
+    else:
+        raise RuntimeError(f"invalid stage: {state.stage}")
+
+
+def normalize_log_differences(sub_scores, del_scores, ins_scores, state_score):
+    """model.jl:720-735."""
+    pos_scores = np.hstack([sub_scores, del_scores[:, None]])
+    pos_exp = np.power(10.0, pos_scores)
+    pos_probs = pos_exp / pos_exp.sum(axis=1, keepdims=True)
+    ins_exp = np.power(10.0, ins_scores)
+    ins_probs = ins_exp / (10.0 ** state_score + ins_exp.sum(axis=1, keepdims=True))
+    return EstimatedProbs(
+        sub=pos_probs[:, :4], dele=pos_probs[:, 4], ins=ins_probs
+    )
+
+
+def estimate_probs(state: RifrafState, params: RifrafParams) -> EstimatedProbs:
+    """Per-base quality estimation: score every edit everywhere
+    (model.jl:737-791)."""
+    tlen = len(state.consensus)
+    sub_scores = np.zeros((tlen, 4)) + state.score
+    del_scores = np.zeros(tlen) + state.score
+    ins_scores = np.zeros((tlen + 1, 4))
+
+    uref = (
+        state.reference is not None
+        and len(state.reference) > 0
+        and params.use_ref_for_qvs
+    )
+    proposals = all_proposals(Stage.SCORE, state.consensus, False)
+    scores = state.aligner.score_proposals(proposals)
+    if uref:
+        scores = scores + state.ref_aligner.score_proposals(
+            proposals, state.consensus, state.reference
+        )
+    for p, score in zip(proposals, scores):
+        if isinstance(p, Substitution):
+            sub_scores[p.pos, p.base] = score
+        elif isinstance(p, Deletion):
+            del_scores[p.pos] = score
+        else:
+            ins_scores[p.pos, p.base] = score
+    max_score = max(sub_scores.max(), del_scores.max(), ins_scores.max())
+    sub_scores -= max_score
+    del_scores -= max_score
+    ins_scores -= max_score
+    if sub_scores.max() > 0.0 or del_scores.max() > 0.0 or ins_scores.max() > 0.0:
+        raise RuntimeError("scores cannot be positive")
+    return normalize_log_differences(
+        sub_scores, del_scores, ins_scores, state.score - max_score
+    )
+
+
+def estimate_point_probs(probs: EstimatedProbs) -> np.ndarray:
+    """Scalar per-base error summary (model.jl:793-802)."""
+    pos_probs = np.hstack([probs.sub, probs.dele[:, None]])
+    no_point_error_prob = pos_probs.max(axis=1)
+    no_ins_error_prob = 1.0 - 0.5 * probs.ins.sum(axis=1)
+    result = 1.0 - (
+        no_point_error_prob * no_ins_error_prob[:-1] * no_ins_error_prob[1:]
+    )
+    return result
+
+
+def base_distribution(base: int, ilp: float) -> np.ndarray:
+    """model.jl:804-809."""
+    lp = np.log10(1.0 - 10.0 ** ilp)
+    result = np.full(4, lp - np.log10(3.0))
+    result[base] = ilp
+    return result
+
+
+def alignment_error_probs(
+    tlen: int, seqs: Sequence[ReadScores], tracebacks: Sequence[Sequence[int]]
+) -> np.ndarray:
+    """Pileup-based per-base error probabilities (model.jl:811-840)."""
+    probs = np.zeros((tlen, 4))
+    for s, moves in zip(seqs, tracebacks):
+        i = j = 0
+        for move in moves:
+            di, dj = align_np.OFFSETS[move]
+            i += di
+            j += dj
+            if move == align_np.TRACE_MATCH:
+                probs[j - 1] += base_distribution(
+                    int(s.seq[i - 1]), s.match_scores[i - 1]
+                )
+    probs = np.power(10.0, probs)
+    probs = 1.0 - (probs / probs.sum(axis=1, keepdims=True)).max(axis=1)
+    return probs
+
+
+def rifraf(
+    dnaseqs: Sequence[np.ndarray],
+    error_log_ps: Optional[Sequence[np.ndarray]] = None,
+    phreds: Optional[Sequence[np.ndarray]] = None,
+    consensus: Optional[np.ndarray] = None,
+    reference: Optional[np.ndarray] = None,
+    params: Optional[RifrafParams] = None,
+) -> RifrafResult:
+    """Find a consensus sequence for a set of reads (model.jl:1116-1287).
+
+    `dnaseqs` are int8 code arrays (or DNA strings); provide either
+    `error_log_ps` (log10 error probabilities) or `phreds`.
+    """
+    from ..utils.constants import encode_seq
+
+    if params is None:
+        params = RifrafParams()
+    dnaseqs = [encode_seq(s) if isinstance(s, str) else np.asarray(s, np.int8)
+               for s in dnaseqs]
+    if isinstance(reference, str):
+        reference = encode_seq(reference)
+    if isinstance(consensus, str):
+        consensus = encode_seq(consensus)
+    if error_log_ps is None:
+        if phreds is None:
+            raise ValueError("provide error_log_ps or phreds")
+        if any(np.min(p) < 0 for p in phreds):
+            raise ValueError("phred score cannot be negative")
+        error_log_ps = [phred_to_log_p(p) for p in phreds]
+
+    ref_len = 0 if reference is None else len(reference)
+    check_params(params.scores, ref_len, params)
+
+    sequences = [
+        make_read_scores(s, p, params.bandwidth, params.scores)
+        for s, p in zip(dnaseqs, error_log_ps)
+    ]
+    state = initial_state(consensus, sequences, reference, params)
+    rng = np.random.default_rng(params.seed)
+
+    enabled = set()
+    if params.do_init:
+        enabled.add(Stage.INIT)
+    if params.do_frame:
+        enabled.add(Stage.FRAME)
+    if params.do_refine:
+        enabled.add(Stage.REFINE)
+    if params.do_score:
+        enabled.add(Stage.SCORE)
+
+    consensus_stages: List[List[np.ndarray]] = [[] for _ in range(len(Stage) - 1)]
+    state.realign_As = True
+    state.realign_Bs = True
+    old_score = -np.inf
+
+    for iteration in range(1, params.max_iters + 1):
+        while state.stage < Stage.SCORE and state.stage not in enabled:
+            state.stage = next_stage(state.stage)
+        if state.stage == Stage.SCORE:
+            break
+        state.stage_iterations[int(state.stage) - 1] += 1
+        consensus_stages[int(state.stage) - 1].append(state.consensus.copy())
+        _log(params, 1, f"iteration {iteration} : {state.stage.name} : {state.score}")
+
+        resample(state, params, rng)
+        realign_rescore(state, params)
+
+        if check_score(state, params, old_score, rng):
+            old_score = state.score
+            state.penalties_increased = False
+            if state.stage == Stage.FRAME and params.seed_indels:
+                indel_seeds = single_indel_proposals(state.consensus, state.reference)
+            else:
+                indel_seeds = []
+            candidates = get_candidates(state, params, indel_seeds=indel_seeds)
+            state.realign_As = True
+            if candidates:
+                _log(params, 2, "  step: handle candidates")
+                handle_candidates(candidates, state, params)
+            else:
+                finish_stage(state, params)
+        else:
+            finish_stage(state, params)
+        if state.converged:
+            break
+
+        if (
+            not params.batch_fixed
+            or (
+                state.stage == Stage.REFINE
+                and state.stage_iterations[int(Stage.REFINE) - 1] > 1
+            )
+        ) and state.batch_size < len(state.sequences):
+            state.batch_randomness *= params.batch_mult
+            _log(params, 2,
+                 f"  batch randomness decreased to {state.batch_randomness}")
+
+    state.stage = Stage.SCORE
+    result = RifrafResult(
+        consensus=state.consensus,
+        params=params,
+        state=state,
+        consensus_stages=consensus_stages,
+    )
+    if params.do_score:
+        _log(params, 2, "computing consensus quality scores")
+        state.realign_As = True
+        state.realign_Bs = True
+        realign_rescore(state, params)
+        result.error_probs = estimate_probs(state, params)
+        result.aln_error_probs = alignment_error_probs(
+            len(state.consensus), state.batch_seqs, state.aligner.tracebacks
+        )
+    _log(params, 1, f"done. converged: {state.converged}")
+    return result
+
+
+def calibrate_phreds(
+    seq: np.ndarray, phred: np.ndarray, consensus: np.ndarray
+) -> np.ndarray:
+    """Rescale error probs so expected #errors matches the edit distance
+    (model.jl:1290-1300)."""
+    n_errors = align_np.edit_distance(consensus, seq)
+    errors = phred_to_p(phred)
+    return errors * float(n_errors) / errors.sum()
+
+
+def correct_shifts(
+    consensus: np.ndarray,
+    reference: np.ndarray,
+    log_p: float = -1.0,
+    bandwidth: int = -1,
+    scores: Optional[Scores] = None,
+) -> np.ndarray:
+    """One-shot frameshift correction against a reference
+    (model.jl:1302-1316)."""
+    from ..models.errormodel import ErrorModel
+    from ..utils.constants import encode_seq
+
+    if isinstance(consensus, str):
+        consensus = encode_seq(consensus)
+    if isinstance(reference, str):
+        reference = encode_seq(reference)
+    if scores is None:
+        scores = Scores.from_error_model(ErrorModel(10.0, 1e-5, 1e-5, 1.0, 1.0))
+    log_ps = np.full(len(reference), log_p)
+    if bandwidth < 0:
+        bandwidth = int(np.ceil(min(len(consensus), len(reference)) * 0.1))
+    refseq = make_read_scores(reference, log_ps, max(bandwidth, 1), scores)
+    proposals = single_indel_proposals(consensus, refseq)
+    return apply_proposals(consensus, proposals)

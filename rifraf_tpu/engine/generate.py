@@ -1,0 +1,138 @@
+"""Proposal generation: which consensus edits to consider.
+
+Mirrors /root/reference/src/model.jl:401-562. All positions here are the
+0-based coordinates of engine.proposals; seed neighborhoods are computed in
+the reference's shared anchor coordinate so the clamping matches exactly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..models.sequences import ReadScores
+from ..ops import align_np
+from ..utils.constants import CODON_LENGTH
+from .proposals import (
+    Deletion,
+    Insertion,
+    Proposal,
+    Substitution,
+    anchor,
+)
+
+
+def all_proposals(
+    stage,
+    consensus: np.ndarray,
+    indel_correction_only: bool,
+    indel_seeds: Sequence[Proposal] = (),
+    seed_neighborhood: int = CODON_LENGTH,
+) -> List[Proposal]:
+    """Every allowed edit at every position, optionally restricted to the
+    neighborhoods of seed indels (model.jl:401-456)."""
+    from .params import Stage
+
+    length = len(consensus)
+    # seed neighborhoods, in anchor coordinates (model.jl:412-422)
+    ins_anchors: Set[int] = set()
+    del_anchors: Set[int] = set()
+    for p in indel_seeds:
+        a = anchor(p)
+        if isinstance(p, Insertion):
+            for idx in range(max(a - seed_neighborhood, 0), min(a + seed_neighborhood, length) + 1):
+                ins_anchors.add(idx)
+        else:
+            for idx in range(max(a - seed_neighborhood, 1), min(a + seed_neighborhood, length) + 1):
+                del_anchors.add(idx)
+
+    do_subs = stage != Stage.FRAME or not indel_correction_only
+    do_indels = stage in (Stage.INIT, Stage.FRAME, Stage.SCORE)
+    no_seeds = len(indel_seeds) == 0
+    results: List[Proposal] = []
+    if do_indels:
+        for base in range(4):
+            results.append(Insertion(0, base))
+    for j in range(length):
+        if do_subs:
+            for base in range(4):
+                if consensus[j] != base:
+                    results.append(Substitution(j, base))
+        if do_indels:
+            # anchors: deletion of consensus[j] has anchor j+1; insertion
+            # after consensus[j] has anchor j+1
+            if no_seeds or (j + 1) in del_anchors:
+                results.append(Deletion(j))
+            if no_seeds or (j + 1) in ins_anchors:
+                for base in range(4):
+                    results.append(Insertion(j + 1, base))
+    return results
+
+
+def moves_to_proposals(
+    moves: Sequence[int], consensus: np.ndarray, seq: np.ndarray
+) -> List[Proposal]:
+    """Edits implied by one read-vs-consensus traceback (model.jl:458-480)."""
+    proposals: List[Proposal] = []
+    i = j = 0
+    for move in moves:
+        di, dj = align_np.OFFSETS[move]
+        i += di
+        j += dj
+        if move == align_np.TRACE_MATCH:
+            if seq[i - 1] != consensus[j - 1]:
+                proposals.append(Substitution(j - 1, int(seq[i - 1])))
+        elif move == align_np.TRACE_INSERT:
+            proposals.append(Insertion(j, int(seq[i - 1])))
+        elif move == align_np.TRACE_DELETE:
+            proposals.append(Deletion(j - 1))
+    return proposals
+
+
+def alignment_proposals(
+    tracebacks: Sequence[Sequence[int]],
+    consensus: np.ndarray,
+    seqs: Sequence[np.ndarray],
+    do_indels: bool,
+) -> List[Proposal]:
+    """Proposals that appear in at least one read alignment
+    (model.jl:483-497)."""
+    result: Set[Proposal] = set()
+    for moves, seq in zip(tracebacks, seqs):
+        for proposal in moves_to_proposals(moves, consensus, seq):
+            if do_indels or isinstance(proposal, Substitution):
+                result.add(proposal)
+    return list(result)
+
+
+def has_single_indels(consensus: np.ndarray, reference: ReadScores) -> bool:
+    """model.jl:532-536."""
+    moves = align_np.align_moves(consensus, reference)
+    return align_np.TRACE_INSERT in moves or align_np.TRACE_DELETE in moves
+
+
+def single_indel_proposals(
+    consensus: np.ndarray, reference: ReadScores
+) -> List[Proposal]:
+    """Single (non-codon) indels from the consensus-vs-reference alignment,
+    used as frame-correction seeds (model.jl:538-562)."""
+    moves = align_np.align_moves(consensus, reference, skew_matches=True)
+    results: List[Proposal] = []
+    cons_idx = 0
+    ref_idx = 0
+    for move in moves:
+        if move == align_np.TRACE_MATCH:
+            cons_idx += 1
+            ref_idx += 1
+        elif move == align_np.TRACE_INSERT:
+            ref_idx += 1
+            results.append(Insertion(cons_idx, int(reference.seq[ref_idx - 1])))
+        elif move == align_np.TRACE_DELETE:
+            cons_idx += 1
+            results.append(Deletion(cons_idx - 1))
+        elif move == align_np.TRACE_CODON_INSERT:
+            ref_idx += 3
+        elif move == align_np.TRACE_CODON_DELETE:
+            cons_idx += 3
+    return results

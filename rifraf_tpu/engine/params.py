@@ -1,0 +1,119 @@
+"""Run parameters and stage machine.
+
+Mirrors /root/reference/src/model.jl:1-5 (Stage), 97-164 (RifrafParams),
+842-896 (check_params). TPU additions: dtype/bucketing knobs for the device
+kernels and a backend selector absent from the reference.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..models.errormodel import ErrorModel, Scores
+from ..utils.constants import CODON_LENGTH
+
+
+class Stage(enum.IntEnum):
+    INIT = 1  # no reference; all proposals
+    FRAME = 2  # reference; indel proposals
+    REFINE = 3  # no reference; substitutions
+    SCORE = 4
+
+
+def next_stage(s: Stage) -> Stage:
+    return Stage(int(s) + 1)
+
+
+DEFAULT_SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+DEFAULT_REF_SCORES = Scores.from_error_model(ErrorModel(10.0, 1e-1, 1e-1, 1.0, 1.0))
+
+
+@dataclass
+class RifrafParams:
+    """All tunables, defaults matching model.jl:97-164."""
+
+    scores: Scores = DEFAULT_SCORES
+    ref_scores: Scores = DEFAULT_REF_SCORES
+    # multiplier for single indel penalties in the reference alignment
+    ref_indel_mult: float = 3.0
+    max_ref_indel_mults: int = 5
+    # multiplier for estimated reference error rate
+    ref_error_mult: float = 1.0
+    do_init: bool = True
+    do_frame: bool = True
+    do_refine: bool = True
+    do_score: bool = False
+    # only propose changes that occur in pairwise alignments
+    do_alignment_proposals: bool = True
+    # seed indel locations from the alignment to reference
+    seed_indels: bool = True
+    # only propose indels during frame correction stage
+    indel_correction_only: bool = True
+    # use reference alignment when estimating quality scores
+    use_ref_for_qvs: bool = False
+    bandwidth: int = 3 * CODON_LENGTH
+    # p-value for increasing bandwidth
+    bandwidth_pvalue: float = 0.1
+    # distance between accepted candidate proposals
+    min_dist: int = 5 * CODON_LENGTH
+    # use top sequences for initial stage and frame correction
+    batch_fixed: bool = True
+    batch_fixed_size: int = 5
+    # if <= 1, no batching is used
+    batch_size: int = 20
+    # 0: top n picked; 0.5: error-weighted; 1: uniform
+    batch_randomness: float = 0.9
+    batch_mult: float = 0.7
+    # score threshold for increasing batch size
+    batch_threshold: float = 0.1
+    max_iters: int = 100
+    verbose: int = 0
+
+    # --- TPU-native additions (no reference equivalent) ---
+    # float dtype for device kernels; float64 matches the reference
+    # bit-for-bit on CPU, float32 is the TPU-native choice
+    dtype: str = "float64"
+    # random seed for batch resampling (the reference uses global RNG state)
+    seed: Optional[int] = 42
+    # pad template lengths up to multiples of this so consensus edits do not
+    # trigger XLA recompilation
+    len_bucket: int = 64
+
+
+def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> None:
+    """model.jl:842-896."""
+    for v in (scores.mismatch, scores.insertion, scores.deletion):
+        if v >= 0.0 or v == -np.inf:
+            raise ValueError("scores must be between -Inf and 0.0")
+    if scores.codon_insertion > -np.inf or scores.codon_deletion > -np.inf:
+        raise ValueError("error model cannot allow codon indels")
+    if reference_len > 0:
+        if params.ref_error_mult <= 0.0:
+            raise ValueError("ref_error_mult must be > 0.0")
+        if params.ref_indel_mult <= 0.0:
+            raise ValueError("ref_indel_mult must be > 0.0")
+        rs = params.ref_scores
+        vals = (rs.mismatch, rs.insertion, rs.deletion, rs.codon_insertion,
+                rs.codon_deletion)
+        if any(v >= 0.0 for v in vals):
+            raise ValueError("ref scores cannot be >= 0")
+        if any(v == -np.inf for v in vals):
+            raise ValueError("ref scores cannot be -Inf")
+        if params.max_ref_indel_mults < 0:
+            raise ValueError("ref_indel_increases must be >= 0")
+    if not any([params.do_init, params.do_frame, params.do_refine, params.do_score]):
+        raise ValueError("no stages enabled")
+    if params.max_iters < 1:
+        raise ValueError(f"invalid max iters: {params.max_iters}")
+    if params.batch_fixed and params.batch_fixed_size <= 1:
+        raise ValueError("batch_fixed_size must be > 1")
+    if not (0.0 <= params.batch_randomness <= 1.0):
+        raise ValueError("batch_randomness must be between 0.0 and 1.0")
+    if not (0.0 <= params.batch_mult <= 1.0):
+        raise ValueError("batch_mult must be between 0.0 and 1.0")
+    if params.batch_threshold < 0.0 or params.batch_mult > 1.0:
+        raise ValueError("batch_threshold must be between 0.0 and 1.0")

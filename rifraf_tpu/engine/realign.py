@@ -1,0 +1,219 @@
+"""Batched realignment engine: device-side A/B/move bands for a read batch.
+
+This replaces the reference's per-read host loops (model.jl:643-714) with
+three batched device launches per iteration (forward+moves, backward,
+proposal scoring), plus host logic for adaptive bandwidth
+(model.jl:643-672). All shapes are bucketed so the hill-climbing loop —
+whose consensus length, bandwidths, and batch size all change — re-uses
+cached XLA executables instead of recompiling:
+
+- template length padded up to `len_bucket` multiples (dynamic true length);
+- band-buffer height K padded to the next multiple of 8;
+- read count and read length fixed per batch selection.
+
+Bandwidth doubling mutates per-read dynamic scalars only; K grows (and
+recompiles, once per bucket) only when a band no longer fits.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.sequences import ReadBatch, ReadScores, batch_reads
+from ..ops import align_jax, align_np
+from ..ops.banded_array import BandedArray
+from ..ops.proposal_jax import score_proposals_batch
+from ..utils.mathops import poisson_cquantile
+from .proposals import Proposal
+from .scoring_np import score_proposal as score_proposal_np
+
+MAX_BANDWIDTH_DOUBLINGS = 5  # model.jl:650: bandwidth * 2^5 cap
+
+
+def _bucket(n: int, b: int) -> int:
+    return ((n + b - 1) // b) * b
+
+
+class BatchAligner:
+    """Cached batched alignments of the current read batch vs the consensus.
+
+    Owns the padded ReadBatch, the device A/B/move bands, per-read
+    bandwidth state, and host tracebacks. The driver mutates the batch
+    membership and the consensus; this class keeps the device state in sync
+    (the As/Bs/Amoves caches of RifrafState, model.jl:176-182).
+    """
+
+    def __init__(self, reads: Sequence[ReadScores], dtype=np.float64, len_bucket: int = 64):
+        self.dtype = np.dtype(dtype)
+        self.len_bucket = int(len_bucket)
+        self.set_batch(list(reads))
+        self.A_bands = None
+        self.B_bands = None
+        self.moves = None
+        self.geom = None
+        self.tracebacks: Optional[List[List[int]]] = None
+        self.scores: Optional[np.ndarray] = None
+
+    # --- batch management -------------------------------------------------
+    def set_batch(self, reads: List[ReadScores]) -> None:
+        self.reads = reads
+        max_len = _bucket(max(len(r) for r in reads), self.len_bucket)
+        self.batch = batch_reads(reads, max_len=max_len, dtype=self.dtype)
+        # mutable per-read bandwidth state (RifrafSequence.bandwidth /
+        # bandwidth_fixed, rifrafsequences.jl:15-17)
+        self.bandwidths = np.array([r.bandwidth for r in reads], dtype=np.int32)
+        self.fixed = np.array([r.bandwidth_fixed for r in reads], dtype=bool)
+        self.est_n_errors = np.array([r.est_n_errors for r in reads])
+        self.A_bands = None
+        self.B_bands = None
+
+    def _padded_template(self, consensus: np.ndarray) -> np.ndarray:
+        T = _bucket(len(consensus) + 1, self.len_bucket)
+        out = np.zeros(T, dtype=np.int8)
+        out[: len(consensus)] = consensus
+        return out
+
+    def _K(self, tlen: int) -> int:
+        batch = self.batch._replace(bandwidth=self.bandwidths)
+        return _bucket(align_jax.band_height(batch, tlen), 8)
+
+    def _current_batch(self) -> ReadBatch:
+        return self.batch._replace(bandwidth=self.bandwidths)
+
+    # --- alignment --------------------------------------------------------
+    def realign(
+        self,
+        consensus: np.ndarray,
+        pvalue: float,
+        realign_As: bool = True,
+        realign_Bs: bool = True,
+        want_moves: bool = True,
+    ) -> None:
+        """Forward (+moves) and backward, with adaptive bandwidth on the
+        first alignment of each read (smart_forward_moves!,
+        model.jl:643-672)."""
+        t = self._padded_template(consensus)
+        tlen = len(consensus)
+        if realign_As:
+            self._old_errors = np.full(len(self.reads), np.iinfo(np.int64).max)
+            for _round in range(MAX_BANDWIDTH_DOUBLINGS + 1):
+                batch = self._current_batch()
+                K = self._K(tlen)
+                bands, moves, scores, geom = align_jax.forward_batch(
+                    t, batch, tlen=tlen, K=K, want_moves=want_moves
+                )
+                self.A_bands, self.moves, self.geom = bands, moves, geom
+                self.scores = np.asarray(scores)
+                if not want_moves:
+                    self.tracebacks = None
+                    break
+                paths, n_errors = align_jax.traceback_batch(
+                    np.asarray(moves), geom, seqs=batch.seq, template=t
+                )
+                self.tracebacks = paths
+                if self.fixed.all():
+                    break
+                grew = self._maybe_grow_bandwidth(n_errors, tlen, pvalue)
+                if not grew:
+                    self.fixed[:] = True
+                    break
+        if realign_Bs:
+            batch = self._current_batch()
+            K = self._K(tlen)
+            B_bands, _, geom = align_jax.backward_batch(t, batch, tlen=tlen, K=K)
+            self.B_bands = B_bands
+            self.geom = geom
+
+    def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float) -> bool:
+        """Double bandwidths of reads whose alignments look band-limited
+        (model.jl:655-671). Returns True if any bandwidth grew."""
+        grew = False
+        for k in range(len(self.reads)):
+            if self.fixed[k]:
+                continue
+            slen = int(self.batch.lengths[k])
+            max_bw = min(int(self.bandwidths[k]) << MAX_BANDWIDTH_DOUBLINGS, tlen, slen)
+            threshold = poisson_cquantile(self.est_n_errors[k], pvalue)
+            if (
+                n_errors[k] > threshold
+                and n_errors[k] < self._old_errors[k]
+                and self.bandwidths[k] < max_bw
+            ):
+                self.bandwidths[k] = min(int(self.bandwidths[k]) * 2, max_bw)
+                self._old_errors[k] = n_errors[k]
+                grew = True
+            else:
+                self.fixed[k] = True
+        return grew
+
+    def total_score(self, weights: Optional[np.ndarray] = None) -> float:
+        """Sum of per-read alignment scores (rescore!, model.jl:630-635)."""
+        if weights is None:
+            return float(np.sum(self.scores))
+        return float(np.dot(weights, self.scores))
+
+    # --- proposal scoring -------------------------------------------------
+    def score_proposals(self, proposals: Sequence[Proposal]) -> np.ndarray:
+        """Total score of each proposal across the batch, one device launch
+        (the reference's per-proposal-per-read host loop, model.jl:385-399)."""
+        per_read = np.asarray(
+            score_proposals_batch(
+                self.A_bands, self.B_bands, self._current_batch(), self.geom, proposals
+            )
+        )
+        return per_read.sum(axis=0)
+
+    def export_bandwidths(self) -> None:
+        """Write adapted bandwidths back into the ReadScores objects so
+        state survives batch reselection (the reference mutates
+        RifrafSequence in place)."""
+        for k, r in enumerate(self.reads):
+            r.bandwidth = int(self.bandwidths[k])
+            r.bandwidth_fixed = bool(self.fixed[k])
+
+
+class RefAligner:
+    """Host-side consensus-vs-reference alignment state (A_ref/B_ref/
+    Amoves_ref, model.jl:180-182). Single sequence with codon moves — stays
+    on the numpy oracle engine."""
+
+    def __init__(self):
+        self.A: Optional[BandedArray] = None
+        self.B: Optional[BandedArray] = None
+        self.Amoves: Optional[BandedArray] = None
+
+    def realign(self, consensus: np.ndarray, ref: ReadScores, pvalue: float,
+                realign_As: bool = True, realign_Bs: bool = True) -> None:
+        """smart_forward_moves! + backward! for the reference."""
+        if realign_As:
+            max_bw = min(ref.bandwidth << MAX_BANDWIDTH_DOUBLINGS, len(consensus), len(ref))
+            if ref.bandwidth_fixed:
+                max_bw = ref.bandwidth
+            n_errors = old_n_errors = np.iinfo(np.int64).max
+            while True:
+                self.A, self.Amoves = align_np.forward_moves(consensus, ref)
+                if ref.bandwidth_fixed or ref.bandwidth >= max_bw:
+                    break
+                old_n_errors = n_errors
+                n_errors = align_np.count_errors_in_moves(self.Amoves, consensus, ref.seq)
+                threshold = poisson_cquantile(ref.est_n_errors, pvalue)
+                if n_errors > threshold and n_errors < old_n_errors:
+                    ref.bandwidth = min(ref.bandwidth * 2, max_bw)
+                else:
+                    break
+            ref.bandwidth_fixed = True
+        if realign_Bs:
+            self.B = align_np.backward(consensus, ref)
+
+    def score(self) -> float:
+        return float(self.A[self.A.nrows - 1, self.A.ncols - 1])
+
+    def score_proposals(self, proposals: Sequence[Proposal],
+                        consensus: np.ndarray, ref: ReadScores) -> np.ndarray:
+        newcols = np.full((self.A.nrows, 4), -np.inf)
+        out = np.empty(len(proposals))
+        for k, p in enumerate(proposals):
+            out[k] = score_proposal_np(p, self.A, self.B, consensus, ref, newcols)
+        return out

@@ -317,14 +317,21 @@ def backward_batch(
 
 
 def traceback_batch(
-    moves: np.ndarray, geom: BandGeometry, max_steps: Optional[int] = None
+    moves: np.ndarray,
+    geom: BandGeometry,
+    max_steps: Optional[int] = None,
+    seqs: Optional[np.ndarray] = None,
+    template: Optional[np.ndarray] = None,
 ):
     """Host-side traceback for every read, vectorized over the batch.
 
     The move band is O(N*K*T) int8 — cheap to ship to host; the pointer
     chase (align.jl:229-238) is inherently sequential per read, so all reads
     step in lockstep here instead of running a device while_loop.
-    Returns a list of per-read move-code lists (reference order).
+    Returns a list of per-read move-code lists (reference order). When
+    `seqs` [N, L] and `template` are given, also returns per-read alignment
+    error counts (mismatches + indel columns, align.jl:240-250) computed
+    during the same walk.
     """
     moves = np.asarray(moves)
     slen = np.asarray(geom.slen)
@@ -338,25 +345,36 @@ def traceback_batch(
         tl = tlen.astype(np.int64)
     j = tl.copy()
     out = [[] for _ in range(N)]
+    count = seqs is not None and template is not None
+    n_errors = np.zeros(N, dtype=np.int64)
     if max_steps is None:
         max_steps = int((slen + tl).max()) + 1
+    rows = np.arange(N)
     for _ in range(max_steps):
         active = (i > 0) | (j > 0)
         if not active.any():
             break
         d = np.clip(i - j + offset, 0, K - 1)
-        m = moves[np.arange(N), d, np.clip(j, 0, moves.shape[2] - 1)]
+        m = moves[rows, d, np.clip(j, 0, moves.shape[2] - 1)]
         m = np.where(active, m, TRACE_NONE)
-        for n in np.nonzero(active)[0]:
-            out[n].append(int(m[n]))
-        di = np.where(m == TRACE_MATCH, 1, 0) + np.where(m == TRACE_INSERT, 1, 0)
-        dj = np.where(m == TRACE_MATCH, 1, 0) + np.where(m == TRACE_DELETE, 1, 0)
         bad = active & (m == TRACE_NONE)
         if bad.any():
             raise RuntimeError(f"traceback hit TRACE_NONE for reads {np.nonzero(bad)[0]}")
+        for n in np.nonzero(active)[0]:
+            out[n].append(int(m[n]))
+        if count:
+            sb = seqs[rows, np.clip(i - 1, 0, seqs.shape[1] - 1)]
+            tb = template[np.clip(j - 1, 0, len(template) - 1)]
+            mism = (m == TRACE_MATCH) & (sb != tb)
+            n_errors += active & (mism | (m == TRACE_INSERT) | (m == TRACE_DELETE))
+        di = np.where(m == TRACE_MATCH, 1, 0) + np.where(m == TRACE_INSERT, 1, 0)
+        dj = np.where(m == TRACE_MATCH, 1, 0) + np.where(m == TRACE_DELETE, 1, 0)
         i = i - di * active
         j = j - dj * active
-    return [ops[::-1] for ops in out]
+    paths = [ops[::-1] for ops in out]
+    if count:
+        return paths, n_errors
+    return paths
 
 
 def band_to_banded_array(

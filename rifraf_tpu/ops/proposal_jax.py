@@ -40,10 +40,14 @@ PTYPE_INS = 1
 PTYPE_DEL = 2
 
 
-def encode_proposals(proposals) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Pack a proposal list into (ptype, pos, base) int arrays."""
-    P = len(proposals)
-    ptype = np.zeros(P, dtype=np.int32)
+def encode_proposals(
+    proposals, pad_to: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack a proposal list into (ptype, pos, base) int arrays, padded to
+    `pad_to` with harmless dummies (so the proposal count does not force an
+    XLA recompile every iteration)."""
+    P = len(proposals) if pad_to is None else pad_to
+    ptype = np.full(P, PTYPE_DEL, dtype=np.int32)
     pos = np.zeros(P, dtype=np.int32)
     base = np.zeros(P, dtype=np.int8)
     for k, p in enumerate(proposals):
@@ -136,15 +140,20 @@ def score_proposals_batch(
     batch: ReadBatch,
     geom: BandGeometry,
     proposals,
+    pad_bucket: int = 128,
 ):
     """Score every proposal against every read. Returns [N, P] scores.
 
     The driver sums over reads (and adds the host-scored reference term) to
     rank candidates; keeping the read axis separate lets a sharded batch
-    `psum` partial sums across chips.
+    `psum` partial sums across chips. The proposal axis is padded up to a
+    `pad_bucket` multiple so iteration-varying candidate counts hit the jit
+    cache.
     """
-    ptype, pos, base = encode_proposals(proposals)
-    return _score_batch(
+    P = len(proposals)
+    padded = ((P + pad_bucket - 1) // pad_bucket) * pad_bucket
+    ptype, pos, base = encode_proposals(proposals, pad_to=padded)
+    out = _score_batch(
         A_bands,
         B_bands,
         jnp.asarray(batch.seq),
@@ -157,3 +166,4 @@ def score_proposals_batch(
         jnp.asarray(pos),
         jnp.asarray(base),
     )
+    return out[:, :P]

@@ -1,0 +1,247 @@
+"""Generative read simulator: the framework's test-data factory.
+
+Mirrors /root/reference/src/sample.jl. An HMM walks a template emitting
+substitution/insertion/deletion errors proportional to per-base error
+probability (codon-indel mode for references); per-read quality tracks
+follow an Exponential phred offset plus Gaussian jitter in the phred
+domain, so "actual" and "reported" error probabilities differ like real
+sequencer quality strings do.
+
+All randomness flows through a numpy Generator for reproducibility (the
+reference uses Julia's global RNG).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..models.errormodel import ErrorModel
+from ..utils.phred import p_to_phred
+
+MIN_PROB = 1e-10
+MAX_PROB = 0.5
+
+
+def random_seq(rng: np.random.Generator, n: int) -> np.ndarray:
+    return rng.integers(0, 4, size=n).astype(np.int8)
+
+
+def mutate_base(rng: np.random.Generator, base: int) -> int:
+    """sample.jl:5-11."""
+    return int((base + rng.integers(1, 4)) % 4)
+
+
+def mutate_seq(rng: np.random.Generator, seq: np.ndarray, n_diffs: int) -> np.ndarray:
+    """Mutate `n_diffs` random positions (sample.jl:13-20; positions drawn
+    with replacement, as in the reference)."""
+    seq = seq.copy()
+    positions = rng.integers(0, len(seq), size=n_diffs)
+    for i in positions:
+        seq[i] = mutate_base(rng, seq[i])
+    return seq
+
+
+def jitter_phred_domain(
+    rng: np.random.Generator, x: np.ndarray, phred_std: float
+) -> np.ndarray:
+    """Independent Gaussian noise in the phred domain (sample.jl:35-42)."""
+    error = rng.standard_normal(len(x)) * phred_std / 10.0
+    result = np.power(10.0, np.log10(x) + error)
+    return np.clip(result, MIN_PROB, MAX_PROB)
+
+
+def hmm_sample(
+    rng: np.random.Generator,
+    sequence: np.ndarray,
+    error_p: np.ndarray,
+    errors: ErrorModel,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The generative error walk (sample.jl:44-123).
+
+    Returns (read, per-base error probs, seqbools, tbools): seqbools[j]
+    marks read base j as correctly sequenced; tbools[j] marks template
+    base j as correctly represented.
+    """
+    errors = errors.normalize()
+    codon = errors.codon_insertion > 0.0 or errors.codon_deletion > 0.0
+    if codon and (errors.insertion > 0.0 or errors.deletion > 0.0):
+        raise ValueError("codon and non-codon indels are not both allowed")
+    sub_ratio = errors.mismatch
+    ins_ratio = errors.codon_insertion if codon else errors.insertion
+    del_ratio = errors.codon_deletion if codon else errors.deletion
+
+    final_seq: List[int] = []
+    final_error_p: List[float] = []
+    seqbools: List[bool] = []
+    tbools: List[bool] = []
+    skip = 0
+    n = len(sequence)
+    for i in range(n + 1):
+        p = error_p[i - 1] if i >= n else error_p[i]
+        prev_p = error_p[0] if i == 0 else error_p[i - 1]
+        # insertion before position i
+        max_p = max(p, prev_p)
+        ins_p = max_p * ins_ratio
+        if codon:
+            ins_p /= 3.0
+        if rng.random() < ins_p:
+            if codon:
+                final_seq.extend(int(b) for b in random_seq(rng, 3))
+                final_error_p.extend([max_p] * 3)
+                seqbools.extend([False] * 3)
+            else:
+                final_seq.append(int(random_seq(rng, 1)[0]))
+                final_error_p.append(max_p)
+                seqbools.append(False)
+        if i >= n:
+            break
+        # only skip after insertions, to ensure equal probability of
+        # insertions and deletions (sample.jl:92-95)
+        if skip > 0:
+            skip -= 1
+            continue
+        # deletion of position i
+        if codon:
+            if i > n - 3:
+                del_p = 0.0
+            else:
+                del_p = float(np.max(error_p[i : i + 3])) * del_ratio / 3.0
+        else:
+            del_p = p * del_ratio
+        if rng.random() < del_p:
+            skip = 2 if codon else 0
+            tbools.extend([False] * (skip + 1))
+        else:
+            if rng.random() < p * sub_ratio:
+                final_seq.append(mutate_base(rng, sequence[i]))
+                seqbools.append(False)
+                tbools.append(False)
+            else:
+                final_seq.append(int(sequence[i]))
+                seqbools.append(True)
+                tbools.append(True)
+            final_error_p.append(p)
+    return (
+        np.array(final_seq, dtype=np.int8),
+        np.array(final_error_p),
+        np.array(seqbools, dtype=bool),
+        np.array(tbools, dtype=bool),
+    )
+
+
+def sample_reference(
+    rng: np.random.Generator,
+    template: np.ndarray,
+    error_rate: float,
+    errors: ErrorModel,
+) -> np.ndarray:
+    """Codon-only errors; length forced to a multiple of 3
+    (sample.jl:125-144)."""
+    norm = errors.normalize()
+    if norm.insertion > 0.0 or norm.deletion > 0.0:
+        raise ValueError("non-codon indels are not allowed in reference")
+    error_p = error_rate * np.ones(len(template))
+    reference, _, _, _ = hmm_sample(rng, template, error_p, errors)
+    if len(reference) % 3 == 1:
+        idx = int(rng.integers(0, len(reference)))
+        reference = np.delete(reference, idx)
+    elif len(reference) % 3 == 2:
+        idx = int(rng.integers(0, len(reference) + 1))
+        reference = np.insert(reference, idx, random_seq(rng, 1)[0])
+    return reference
+
+
+def sample_from_template(
+    rng: np.random.Generator,
+    template: np.ndarray,
+    template_error_p: np.ndarray,
+    errors: ErrorModel,
+    phred_scale: float,
+    actual_std: float,
+    reported_std: float,
+):
+    """One read: exponential phred offset + Gaussian jitter
+    (sample.jl:146-171)."""
+    errors = errors.normalize()
+    if errors.codon_insertion > 0.0 or errors.codon_deletion > 0.0:
+        raise ValueError("codon indels are not allowed in sequences")
+    offset = rng.exponential(phred_scale)
+    base_vector = np.power(
+        10.0, (-10.0 * np.log10(template_error_p) + offset) / (-10.0)
+    )
+    jittered_error_p = jitter_phred_domain(rng, base_vector, actual_std)
+    seq, actual_error_p, sbools, tbools = hmm_sample(
+        rng, template, jittered_error_p, errors
+    )
+    reported_error_p = jitter_phred_domain(rng, actual_error_p, reported_std)
+    phreds = p_to_phred(reported_error_p)
+    return seq, actual_error_p, phreds, sbools, tbools
+
+
+def sample_mixture(
+    nseqs: Tuple[int, int],
+    length: int,
+    n_diffs: int,
+    ref_error_rate: float = 0.1,
+    ref_errors: ErrorModel = ErrorModel(10, 0, 0, 1, 0),
+    error_rate: float = 0.01,
+    alpha: float = 0.1,
+    phred_scale: float = 1.5,
+    actual_std: float = 3.0,
+    reported_std: float = 1.0,
+    seq_errors: ErrorModel = ErrorModel(1, 5, 5),
+    rng: Optional[np.random.Generator] = None,
+):
+    """Two templates differing at n_diffs positions; reads from both
+    (sample.jl:173-220)."""
+    if rng is None:
+        rng = np.random.default_rng()
+    template1 = random_seq(rng, length)
+    template2 = mutate_seq(rng, template1, n_diffs)
+    templates = [template1, template2]
+
+    reference = sample_reference(rng, template1, ref_error_rate, ref_errors)
+
+    # four-parameter Beta distribution of per-base template error rates
+    beta = alpha * (error_rate - MAX_PROB) / (MIN_PROB - error_rate)
+    template_error_p = (
+        rng.beta(alpha, beta, size=length) * (MAX_PROB - MIN_PROB) + MIN_PROB
+    )
+
+    seqs, actual_error_ps, phreds, seqbools, tbools = [], [], [], [], []
+    for t, n in zip(templates, nseqs):
+        for _ in range(n):
+            seq, actual_error_p, phred, cb, db = sample_from_template(
+                rng, t, template_error_p, seq_errors, phred_scale,
+                actual_std, reported_std,
+            )
+            seqs.append(seq)
+            actual_error_ps.append(actual_error_p)
+            phreds.append(phred)
+            seqbools.append(cb)
+            tbools.append(db)
+    return (
+        reference,
+        templates,
+        template_error_p,
+        seqs,
+        actual_error_ps,
+        phreds,
+        seqbools,
+        tbools,
+    )
+
+
+def sample_sequences(
+    nseqs: int = 3,
+    length: int = 90,
+    rng: Optional[np.random.Generator] = None,
+    **kwargs,
+):
+    """Single-template convenience wrapper (sample.jl:277-298)."""
+    (ref, templates, t_p, seqs, actual, phreds, cb, db) = sample_mixture(
+        (nseqs, 0), length, 0, rng=rng, **kwargs
+    )
+    return ref, templates[0], t_p, seqs, actual, phreds, cb, db

@@ -16,6 +16,33 @@ def logsumexp10(x) -> float:
     return float(np.log10(np.sum(np.power(10.0, x - u))) + u)
 
 
+def poisson_cquantile(mean: float, pvalue: float) -> float:
+    """Complementary quantile of Poisson(mean): smallest k with
+    P(X > k) <= pvalue. Matches Distributions.cquantile used for adaptive
+    bandwidth (reference model.jl:661). Exact summation for small means,
+    Wilson-Hilferty normal approximation for large ones."""
+    from statistics import NormalDist
+
+    if mean <= 0:
+        return 0.0
+    target = 1.0 - pvalue
+    if mean < 50.0:
+        import math
+
+        pmf = math.exp(-mean)
+        cdf = pmf
+        k = 0
+        while cdf < target and k < 10_000:
+            k += 1
+            pmf *= mean / k
+            cdf += pmf
+        return float(k)
+    z = NormalDist().inv_cdf(target)
+    # Wilson-Hilferty transformation for the Poisson quantile
+    k = mean * (1.0 - 1.0 / (9.0 * mean) + z / (3.0 * mean ** 0.5)) ** 3
+    return float(np.ceil(k))
+
+
 def summax(a, b) -> float:
     """Max-plus inner product: max_i(a[i] + b[i]) (util.jl:40-48).
 

@@ -15,3 +15,7 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_enable_x64", True)
+# persistent compilation cache: the engine's bucketed shapes mean a small,
+# stable set of executables — reuse them across test runs
+jax.config.update("jax_compilation_cache_dir", "/tmp/rifraf_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

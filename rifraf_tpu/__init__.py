@@ -1,0 +1,119 @@
+"""rifraf_tpu: TPU-native RIFRAF consensus framework.
+
+A from-scratch JAX/XLA re-design of the RIFRAF reference-informed
+frame-restoring consensus algorithm (reference: sdwfrost/Rifraf.jl). The
+public surface mirrors the reference's export list (src/Rifraf.jl:12-45);
+the engine underneath is batched, bucketed, and device-sharded.
+"""
+
+from .engine.driver import (
+    EstimatedProbs,
+    RifrafResult,
+    RifrafState,
+    calibrate_phreds,
+    correct_shifts,
+    estimate_point_probs,
+    rifraf,
+)
+from .engine.params import RifrafParams, Stage
+from .engine.proposals import (
+    AmbiguousProposalsError,
+    Deletion,
+    Insertion,
+    Proposal,
+    ScoredProposal,
+    Substitution,
+    apply_proposals,
+    choose_candidates,
+)
+from .io.fastx import (
+    read_fasta,
+    read_fasta_records,
+    read_fastq,
+    read_samples,
+    write_fasta,
+    write_fastq,
+    write_samples,
+)
+from .models.errormodel import ErrorModel, Scores
+from .models.sequences import (
+    ReadBatch,
+    ReadScores,
+    batch_reads,
+    make_read_scores,
+    read_scores_from_phreds,
+)
+from .ops.align_np import align, align_moves
+from .ops.banded_array import BandedArray
+from .sim.sample import (
+    sample_from_template,
+    sample_mixture,
+    sample_sequences,
+)
+from .utils.constants import (
+    BASES,
+    CODON_LENGTH,
+    decode_seq,
+    encode_seq,
+)
+from .utils.mathops import logsumexp10, summax
+from .utils.phred import (
+    cap_phreds,
+    normalize,
+    p_to_phred,
+    phred_to_log_p,
+    phred_to_p,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "rifraf",
+    "RifrafParams",
+    "RifrafResult",
+    "RifrafState",
+    "Stage",
+    "EstimatedProbs",
+    "estimate_point_probs",
+    "calibrate_phreds",
+    "correct_shifts",
+    "ErrorModel",
+    "Scores",
+    "normalize",
+    "ReadScores",
+    "ReadBatch",
+    "make_read_scores",
+    "read_scores_from_phreds",
+    "batch_reads",
+    "BandedArray",
+    "align",
+    "align_moves",
+    "Proposal",
+    "Substitution",
+    "Insertion",
+    "Deletion",
+    "ScoredProposal",
+    "AmbiguousProposalsError",
+    "apply_proposals",
+    "choose_candidates",
+    "sample_sequences",
+    "sample_mixture",
+    "sample_from_template",
+    "read_fasta",
+    "read_fasta_records",
+    "write_fasta",
+    "read_fastq",
+    "write_fastq",
+    "write_samples",
+    "read_samples",
+    "encode_seq",
+    "decode_seq",
+    "BASES",
+    "CODON_LENGTH",
+    "logsumexp10",
+    "summax",
+    "p_to_phred",
+    "phred_to_log_p",
+    "phred_to_p",
+    "cap_phreds",
+]

@@ -1,0 +1,173 @@
+"""Batch consensus CLI.
+
+Mirrors /root/reference/scripts/rifraf.jl: a glob of FASTQ files, one
+consensus each, FASTA out, with per-file reference lookup via a TSV map.
+Where the reference fans files out over Julia worker processes with `pmap`
+(scripts/rifraf.jl:190-191), this CLI runs the cluster sweep through
+rifraf_tpu.parallel (device-sharded when multiple chips are visible,
+otherwise sequential on one accelerator — the device is the parallelism).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import os
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from ..engine.driver import rifraf
+from ..engine.params import RifrafParams
+from ..io.fastx import read_fasta_records, read_fastq, write_fasta
+from ..models.errormodel import ErrorModel, Scores
+from ..utils.constants import encode_seq
+from ..utils.phred import cap_phreds
+
+
+def parse_error_model(spec: str) -> Scores:
+    """Comma-separated ratio string -> Scores (scripts/rifraf.jl:98-102)."""
+    parts = [float(x) for x in spec.split(",")]
+    return Scores.from_error_model(ErrorModel(*parts))
+
+
+def common_prefix(strings: List[str]) -> str:
+    """scripts/rifraf.jl:122-133."""
+    if not strings:
+        return ""
+    minlen = min(len(s) for s in strings)
+    x = 0
+    for i in range(minlen):
+        if all(s[i] == strings[0][i] for s in strings):
+            x = i + 1
+        else:
+            break
+    return strings[0][:x]
+
+
+def common_suffix(strings: List[str]) -> str:
+    return common_prefix([s[::-1] for s in strings])[::-1]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rifraf-tpu",
+        description="Compute consensus sequences from noisy phred-scored reads.",
+    )
+    p.add_argument("--phred-cap", type=int, default=0, help="maximum PHRED score")
+    p.add_argument("--prefix", type=str, default="",
+                   help="prepended to each filename to make name")
+    p.add_argument("--keep-unique-name", action="store_true",
+                   help="keep only unique middle part of filename")
+    p.add_argument("--reference", type=str, default="",
+                   help="reference fasta file; uses first sequence unless "
+                        "--reference-map is given")
+    p.add_argument("--reference-map", type=str, default="",
+                   help="file mapping input filename to reference id")
+    p.add_argument("--ref-errors", type=str, default="10,0.1,0.1,1,1",
+                   help="comma-separated reference error ratios - "
+                        "mm, ins, del, codon ins, codon del")
+    p.add_argument("--max-iters", type=int, default=100)
+    p.add_argument("--verbose", "-v", type=int, default=0)
+    p.add_argument("seq_errors", metavar="seq-errors",
+                   help="comma-separated sequence error ratios - "
+                        "mismatch, insertion, deletion")
+    p.add_argument("input", help="a single file or a glob; filenames must be unique")
+    p.add_argument("output", help="output fasta file")
+    return p
+
+
+def dofile(path: str, reffile: str, refid: str, args) -> "RifrafResult":
+    """One consensus job (scripts/rifraf.jl:71-120)."""
+    if args.verbose >= 1:
+        print(f"reading sequences from '{path}'", file=sys.stderr)
+    reference = None
+    if reffile:
+        ref_records = read_fasta_records(reffile)
+        if refid:
+            matches = [r for r in ref_records if r[0] == refid]
+            if len(matches) == 0:
+                raise ValueError(f"reference '{refid}' not found in '{reffile}'")
+            if len(matches) > 1:
+                raise ValueError(
+                    f"multiple references with id '{refid}' found in '{reffile}'"
+                )
+            reference = encode_seq(matches[0][1])
+        elif ref_records:
+            reference = encode_seq(ref_records[0][1])
+
+    scores = parse_error_model(args.seq_errors)
+    ref_scores = parse_error_model(args.ref_errors)
+    sequences, phreds, _ = read_fastq(path)
+    if args.phred_cap > 0:
+        phreds = [cap_phreds(p, args.phred_cap) for p in phreds]
+    params = RifrafParams(
+        scores=scores,
+        ref_scores=ref_scores,
+        max_iters=args.max_iters,
+        verbose=args.verbose,
+    )
+    return rifraf(sequences, phreds=phreds, reference=reference, params=params)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    infiles = sorted(globlib.glob(args.input))
+    if not infiles:
+        if args.verbose >= 1:
+            print("warning: no input files found.", file=sys.stderr)
+        return 0
+    basenames = [os.path.basename(f) for f in infiles]
+    if len(set(basenames)) != len(basenames):
+        raise ValueError("Files do not have unique names")
+
+    if args.reference:
+        if not os.path.isfile(args.reference):
+            raise ValueError("reference file not found")
+        if args.reference_map and not os.path.isfile(args.reference_map):
+            raise ValueError("reference map file not found")
+    elif args.reference_map:
+        raise ValueError("--reference-map is invalid without --reference")
+
+    refids = [""] * len(infiles)
+    if args.reference_map:
+        name_to_ref = {}
+        with open(args.reference_map) as fh:
+            for line in fh:
+                if line.strip():
+                    name, refid = line.split()
+                    name_to_ref[name] = refid
+        infiles = sorted(
+            f for f in infiles if os.path.basename(f) in name_to_ref
+        )
+        basenames = [os.path.basename(f) for f in infiles]
+        refids = [name_to_ref[n] for n in basenames]
+
+    results = [
+        dofile(f, args.reference, rid, args) for f, rid in zip(infiles, refids)
+    ]
+
+    plen = slen = 0
+    if args.keep_unique_name:
+        plen = len(common_prefix(basenames))
+        snames = [n[plen:] for n in basenames]
+        slen = len(common_suffix(snames))
+
+    n_converged = 0
+    out_seqs, out_names = [], []
+    for name, result in zip(basenames, results):
+        if result.state.converged:
+            n_converged += 1
+            if args.keep_unique_name:
+                name = name[plen : len(name) - slen]
+            out_names.append(args.prefix + name)
+            out_seqs.append(result.consensus)
+    write_fasta(args.output, out_seqs, names=out_names)
+    if args.verbose >= 1:
+        print(f"done. {n_converged} / {len(results)} converged.", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Frameshift-correction CLI.
+
+Mirrors /root/reference/scripts/correct_shifts.jl: FASTA in (sequence/
+reference pairs, or all sequences sharing the first record as reference),
+`correct_shifts` each, FASTA out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..engine.driver import correct_shifts
+from ..io.fastx import read_fasta_records, write_fasta
+from ..utils.constants import encode_seq
+from .consensus import parse_error_model
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rifraf-tpu-correct-shifts",
+        description="Correct frame-shifting indels against a reference.",
+    )
+    p.add_argument("--multi-reference", action="store_true",
+                   help="each sequence is followed by its reference")
+    p.add_argument("--log-p", type=float, default=-1.0,
+                   help="log error probability")
+    p.add_argument("--bandwidth", type=int, default=-1,
+                   help="alignment bandwidth; if < 0, choose dynamically")
+    p.add_argument("--errors", type=str, default="10,0.00001,0.00001,1,1",
+                   help="comma-separated reference error ratios - "
+                        "mm, ins, del, codon ins, codon del")
+    p.add_argument("--verbose", "-v", type=int, default=0)
+    p.add_argument("input",
+                   help="input fasta file, sequence/reference alternating pairs")
+    p.add_argument("output", help="output fasta file of corrected sequences")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    scores = parse_error_model(args.errors)
+    records = read_fasta_records(args.input)
+    if args.multi_reference:
+        sequences = records[0::2]
+        references = records[1::2]
+    else:
+        sequences = records[1:]
+        references = [records[0]] * len(records[1:])
+    out_seqs, out_names = [], []
+    for (name, seq), (_, ref) in zip(sequences, references):
+        result = correct_shifts(
+            encode_seq(seq),
+            encode_seq(ref),
+            log_p=args.log_p,
+            bandwidth=args.bandwidth,
+            scores=scores,
+        )
+        out_names.append(name)
+        out_seqs.append(result)
+    write_fasta(args.output, out_seqs, names=out_names)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

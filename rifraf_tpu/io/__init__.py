@@ -1,0 +1,9 @@
+from .fastx import (
+    read_fasta,
+    read_fasta_records,
+    read_fastq,
+    read_samples,
+    write_fasta,
+    write_fastq,
+    write_samples,
+)

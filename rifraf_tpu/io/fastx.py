@@ -1,0 +1,139 @@
+"""FASTA/FASTQ I/O.
+
+Pure-Python parser/writer mirroring /root/reference/src/fastxio.jl (which
+wraps BioSequences' FASTA/FASTQ readers): sequences come back as int8 code
+arrays, phreds as int8 arrays (Sanger offset 33), names default to
+``seq_<i>``, and negative phreds are rejected (fastxio.jl:64-74).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.constants import decode_seq, encode_seq
+
+PHRED_OFFSET = 33
+
+
+def read_fasta_records(filename: str) -> List[Tuple[str, str]]:
+    """(name, sequence-string) pairs from a FASTA file (fastxio.jl:10-17)."""
+    records: List[Tuple[str, str]] = []
+    name: Optional[str] = None
+    chunks: List[str] = []
+    with open(filename) as fh:
+        for line in fh:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            if line.startswith(">"):
+                if name is not None:
+                    records.append((name, "".join(chunks)))
+                name = line[1:].split()[0] if len(line) > 1 else ""
+                chunks = []
+            else:
+                if name is None:
+                    raise ValueError(f"{filename}: sequence before header")
+                chunks.append(line.strip())
+    if name is not None:
+        records.append((name, "".join(chunks)))
+    return records
+
+
+def read_fasta(filename: str) -> List[np.ndarray]:
+    """fastxio.jl:20-31."""
+    return [encode_seq(s) for _, s in read_fasta_records(filename)]
+
+
+def write_fasta(
+    filename: str, seqs: Sequence, names: Optional[Sequence[str]] = None
+) -> None:
+    """fastxio.jl:34-53."""
+    if names is None or len(names) == 0:
+        names = [f"seq_{i + 1}" for i in range(len(seqs))]
+    with open(filename, "w") as fh:
+        for name, seq in zip(names, seqs):
+            if not isinstance(seq, str):
+                seq = decode_seq(seq)
+            fh.write(f">{name}\n{seq}\n")
+
+
+def read_fastq(
+    filename: str,
+) -> Tuple[List[np.ndarray], List[np.ndarray], List[str]]:
+    """Sequences, phreds, and names from a FASTQ file (fastxio.jl:56-98).
+
+    Rejects negative phred values like the reference (fastxio.jl:66-69).
+    """
+    seqs: List[np.ndarray] = []
+    phreds: List[np.ndarray] = []
+    names: List[str] = []
+    with open(filename) as fh:
+        while True:
+            header = fh.readline()
+            if not header:
+                break
+            header = header.rstrip("\n")
+            if not header:
+                continue
+            if not header.startswith("@"):
+                raise ValueError(f"{filename}: bad FASTQ header {header!r}")
+            seq = fh.readline().rstrip("\n")
+            plus = fh.readline()
+            qual = fh.readline().rstrip("\n")
+            if not plus.startswith("+"):
+                raise ValueError(f"{filename}: malformed FASTQ record")
+            if len(qual) != len(seq):
+                raise ValueError(f"{filename}: quality length mismatch")
+            name = header[1:].split()[0] if len(header) > 1 else ""
+            q = np.frombuffer(qual.encode("ascii"), dtype=np.uint8).astype(
+                np.int16
+            ) - PHRED_OFFSET
+            if (q < 0).any():
+                raise ValueError(
+                    f"{name} in {filename} contains negative phred values"
+                )
+            seqs.append(encode_seq(seq))
+            phreds.append(q.astype(np.int8))
+            names.append(name)
+    return seqs, phreds, names
+
+
+def write_fastq(
+    filename: str,
+    seqs: Sequence,
+    phreds: Sequence[np.ndarray],
+    names: Optional[Sequence[str]] = None,
+) -> None:
+    """fastxio.jl:101-124."""
+    if names is None or len(names) != len(seqs):
+        names = [f"seq_{i + 1}" for i in range(len(seqs))]
+    with open(filename, "w") as fh:
+        for seq, q, name in zip(seqs, phreds, names):
+            if not isinstance(seq, str):
+                seq = decode_seq(seq)
+            qual = "".join(chr(int(v) + PHRED_OFFSET) for v in q)
+            fh.write(f"@{name}\n{seq}\n+\n{qual}\n")
+
+
+def write_samples(prefix: str, reference, template, template_error, seqs, phreds) -> None:
+    """Persist a simulated dataset (sample.jl:301-307)."""
+    from ..utils.phred import p_to_phred
+
+    template_phred = p_to_phred(np.asarray(template_error))
+    write_fasta(f"{prefix}-reference.fasta", [reference])
+    write_fastq(f"{prefix}-template.fastq", [template], [template_phred])
+    write_fastq(f"{prefix}-sequences.fastq", seqs, phreds)
+
+
+def read_samples(prefix: str):
+    """Round-trip a simulated dataset (sample.jl:310-316)."""
+    from ..utils.phred import phred_to_p
+
+    reference = read_fasta(f"{prefix}-reference.fasta")[0]
+    template_seqs, template_phreds, _ = read_fastq(f"{prefix}-template.fastq")
+    template = template_seqs[0]
+    template_error = phred_to_p(template_phreds[0])
+    seqs, phreds, _ = read_fastq(f"{prefix}-sequences.fastq")
+    return reference, template, template_error, seqs, phreds

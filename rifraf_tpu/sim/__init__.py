@@ -1,0 +1,7 @@
+from .sample import (
+    hmm_sample,
+    sample_from_template,
+    sample_mixture,
+    sample_reference,
+    sample_sequences,
+)

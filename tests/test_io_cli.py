@@ -1,0 +1,134 @@
+"""I/O round-trips, simulator statistics, and end-to-end CLI runs.
+
+The CLI-on-shipped-data check mirrors the reference's de-facto golden test
+(docs/src/examples.md:60-69 + data/): running the batch consensus CLI on
+data/input-reads-*.fastq with data/references.fasta must reproduce each
+cluster's true template.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.cli.consensus import main as consensus_main
+from rifraf_tpu.cli.shifts import main as shifts_main
+from rifraf_tpu.io.fastx import (
+    read_fasta,
+    read_fastq,
+    read_samples,
+    write_fasta,
+    write_fastq,
+    write_samples,
+)
+from rifraf_tpu.sim.sample import sample_mixture, sample_sequences
+from rifraf_tpu.utils.constants import decode_seq, encode_seq
+
+DATA = os.path.join(os.path.dirname(__file__), "..", "data")
+
+
+def test_fasta_roundtrip(tmp_path):
+    path = str(tmp_path / "test.fasta")
+    seqs = [encode_seq("ACGTACGT"), encode_seq("TTTT")]
+    write_fasta(path, seqs, names=["a", "b"])
+    got = read_fasta(path)
+    assert [decode_seq(s) for s in got] == ["ACGTACGT", "TTTT"]
+
+
+def test_fastq_roundtrip(tmp_path):
+    path = str(tmp_path / "test.fastq")
+    seqs = [encode_seq("ACGT"), encode_seq("GGCC")]
+    phreds = [np.array([10, 20, 30, 40], dtype=np.int8),
+              np.array([1, 2, 3, 93], dtype=np.int8)]
+    write_fastq(path, seqs, phreds, names=["x", "y"])
+    gseqs, gphreds, gnames = read_fastq(path)
+    assert [decode_seq(s) for s in gseqs] == ["ACGT", "GGCC"]
+    np.testing.assert_array_equal(gphreds[0], phreds[0])
+    np.testing.assert_array_equal(gphreds[1], phreds[1])
+    assert gnames == ["x", "y"]
+
+
+def test_fastq_rejects_negative_phreds(tmp_path):
+    path = str(tmp_path / "bad.fastq")
+    with open(path, "w") as fh:
+        fh.write("@s\nAC\n+\n" + chr(33 - 1) + chr(40) + "\n")
+    with pytest.raises(ValueError):
+        read_fastq(path)
+
+
+def test_default_names(tmp_path):
+    path = str(tmp_path / "t.fastq")
+    write_fastq(path, [encode_seq("AC")], [np.array([5, 5], dtype=np.int8)])
+    _, _, names = read_fastq(path)
+    assert names == ["seq_1"]
+
+
+def test_samples_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    ref, template, t_p, seqs, actual, phreds, cb, db = sample_sequences(
+        nseqs=4, length=40, rng=rng
+    )
+    prefix = str(tmp_path / "sim")
+    write_samples(prefix, ref, template, t_p, seqs, phreds)
+    gref, gtemplate, gt_err, gseqs, gphreds = read_samples(prefix)
+    assert decode_seq(gref) == decode_seq(ref)
+    assert decode_seq(gtemplate) == decode_seq(template)
+    assert len(gseqs) == 4
+
+
+def test_simulator_error_rate_statistics():
+    """Mean template error rate tracks the request (test_sample.jl:39-45)."""
+    rng = np.random.default_rng(123)
+    _, _, t_p, _, _, _, _, _ = sample_sequences(
+        nseqs=2, length=5000, error_rate=0.01, alpha=1.0, rng=rng
+    )
+    assert 0.003 < np.mean(t_p) < 0.03
+
+
+def test_simulator_mixture_sizes():
+    """test_sample.jl:47-55."""
+    rng = np.random.default_rng(5)
+    ref, templates, t_p, seqs, actual, phreds, cb, db = sample_mixture(
+        (3, 2), 50, 3, rng=rng
+    )
+    assert len(templates) == 2
+    assert len(seqs) == 5
+    assert len(ref) % 3 == 0
+
+
+def test_consensus_cli_recovers_templates(tmp_path):
+    """End-to-end golden run on the shipped example data."""
+    out = str(tmp_path / "consensus.fasta")
+    rc = consensus_main(
+        [
+            "--reference", os.path.join(DATA, "references.fasta"),
+            "--reference-map", os.path.join(DATA, "ref-map.tsv"),
+            "--phred-cap", "30",
+            "1,2,2",
+            os.path.join(DATA, "input-reads-*.fastq"),
+            out,
+        ]
+    )
+    assert rc == 0
+    got = read_fasta(out)
+    assert len(got) == 2
+    for k, seq in enumerate(got, start=1):
+        with open(os.path.join(DATA, f"template-{k}.txt")) as fh:
+            want = fh.read().strip()
+        assert decode_seq(seq) == want, f"cluster {k} consensus != template"
+
+
+def test_shifts_cli(tmp_path):
+    infile = str(tmp_path / "in.fasta")
+    outfile = str(tmp_path / "out.fasta")
+    # reference first, then sequences sharing it
+    write_fasta(
+        infile,
+        [encode_seq("AAACCCGGGTTT"), encode_seq("AAACCGGGTTT")],
+        names=["ref", "broken"],
+    )
+    rc = shifts_main([infile, outfile])
+    assert rc == 0
+    got = read_fasta(outfile)
+    assert len(got) == 1
+    assert len(got[0]) % 3 == 0

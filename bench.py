@@ -1,0 +1,87 @@
+"""Headline benchmark: the fused consensus step on 1 kb x 256 reads.
+
+One step = batched banded forward + backward fills plus rescoring of ALL
+~9xLen single-base edits against every read — the per-iteration work of the
+reference's hill-climbing loop (align.jl:155-212 fills + model.jl:242-285
+rescoring, BASELINE.json config "1 kb template x 256 reads").
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+`vs_baseline` is the speedup over this repo's measured CPU-backend number
+(same code, jax CPU, this host class — recorded in BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# CPU backend measurement of the identical step on the dev host
+# (see BASELINE.md "measured baselines"): 7.474e4 proposal-scores/sec.
+CPU_BASELINE_PROPOSAL_SCORES_PER_SEC = 7.474e4
+
+TLEN = 1000
+N_READS = 256
+BANDWIDTH = 16
+
+
+def build_problem():
+    from rifraf_tpu.engine.proposals import Deletion, Insertion, Substitution
+    from rifraf_tpu.models.errormodel import ErrorModel, Scores
+    from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+
+    scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+    rng = np.random.default_rng(0)
+    template = rng.integers(0, 4, size=TLEN).astype(np.int8)
+    reads = []
+    for _ in range(N_READS):
+        slen = int(rng.integers(950, 1050))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -1.0, size=slen)
+        reads.append(make_read_scores(s, log_p, BANDWIDTH, scores))
+    batch = batch_reads(reads, dtype=np.float32)
+    proposals = (
+        [Substitution(p, b) for p in range(TLEN) for b in range(4)]
+        + [Insertion(p, b) for p in range(TLEN + 1) for b in range(4)]
+        + [Deletion(p) for p in range(TLEN)]
+    )
+    return template, batch, proposals
+
+
+def main():
+    import jax
+
+    from rifraf_tpu.ops import align_jax
+    from rifraf_tpu.ops.proposal_jax import score_proposals_batch
+
+    template, batch, proposals = build_problem()
+    P = len(proposals)
+
+    def step():
+        A, _, _, geom = align_jax.forward_batch(template, batch, want_moves=False)
+        B, _, _ = align_jax.backward_batch(template, batch)
+        return score_proposals_batch(A, B, batch, geom, proposals)
+
+    # warmup / compile
+    jax.block_until_ready(step())
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        jax.block_until_ready(step())
+        times.append(time.time() - t0)
+    dt = min(times)
+    value = N_READS * P / dt
+    out = {
+        "metric": "proposal_scores_per_sec_1kb_256reads",
+        "value": round(value, 1),
+        "unit": "proposal-scores/s",
+        "vs_baseline": round(value / CPU_BASELINE_PROPOSAL_SCORES_PER_SEC, 2),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

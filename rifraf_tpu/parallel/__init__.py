@@ -1,0 +1,7 @@
+from .sharding import (
+    READS_AXIS,
+    make_mesh,
+    pad_batch_to,
+    shard_batch,
+    sharded_consensus_step,
+)

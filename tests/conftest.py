@@ -7,13 +7,15 @@ tolerances).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
+# force CPU regardless of ambient JAX_PLATFORMS (the env var can be
+# overridden by the harness; the config option always wins)
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 # persistent compilation cache: the engine's bucketed shapes mean a small,
 # stable set of executables — reuse them across test runs

@@ -1,0 +1,290 @@
+"""Pallas TPU kernel for the batched banded forward DP.
+
+The XLA path (align_jax) runs a lax.scan over template columns; each step is
+a small [N, K] vector op, so the loop is overhead-bound. This kernel runs
+the whole column sweep on-core:
+
+- **Reads on lanes**: a block of 128 reads occupies the 128-lane axis; the
+  band (K data rows) sits on sublanes. One column update is a single
+  [K, 128] VPU tile operation.
+- **Pre-shifted tables**: each read's per-base score tables are written
+  into a [Lbuf, 128] buffer at row offset `K + off_k (+1)`, so the window
+  needed for column j starts at row `j + K` for EVERY read — one contiguous
+  dynamic slice per table per column, no gathers (the diagonal-aligned band
+  layout of bandedarrays.jl:101-114 makes the window contiguous).
+- **Sequential grid**: grid = (read_blocks, T+1); the DP carry lives in a
+  VMEM scratch ref that persists across the sequentially-iterated column
+  axis; each step writes one [K, 128] band column block to the output.
+- The within-column insert chain uses the same max-plus closed form as the
+  XLA kernel (F = G + cummax(cand - G)), computed along sublanes.
+
+Used for score-only forward/backward fills (realignment + rescoring); the
+moves-recording variant stays on the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.sequences import ReadBatch
+from .align_jax import BandGeometry, batch_geometry
+
+NEG_INF = float(np.finfo(np.float32).min) / 2  # avoid inf arithmetic on VPU
+
+LANES = 128
+
+
+def _cumop(x, op, K):
+    """Inclusive scan along sublanes (axis 0) via log-step doubling."""
+    s = 1
+    while s < K:
+        shifted = pltpu.roll(x, s, axis=0)
+        # rows < s have no source; mask them to identity by using iota
+        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+        x = jnp.where(idx >= s, op(x, shifted), x)
+        s *= 2
+    return x
+
+
+# Columns computed per grid step. Measured on TPU v5e: the per-column body
+# (two log-K sublane-roll scans) dominates, so batching columns does not
+# amortize anything — 1 is fastest.
+COLS_PER_STEP = 1
+
+
+def _forward_kernel(
+    tlen_ref,  # SMEM [1, 1] true template length
+    t_ref,  # VMEM [Tpad, 1] int32 template codes
+    match_ref,  # VMEM [Lbuf, 128]
+    mismatch_ref,
+    ins_ref,
+    dels_ref,
+    seq_ref,  # VMEM [Lbuf, 128] int32 codes (padded with -9)
+    off_ref,  # VMEM [1, 128] int32 per-read offset
+    slen_ref,  # VMEM [1, 128] int32
+    nd_ref,  # VMEM [1, 128] int32
+    dend_ref,  # VMEM [1, 128] int32 data row of the final cell
+    out_ref,  # VMEM [COLS_PER_STEP * K, 128] band columns for this step
+    score_ref,  # VMEM [1, 128] final scores (last grid step)
+    carry,  # scratch VMEM [K, 128]
+    acc_score,  # scratch VMEM [1, 128]
+    *,
+    K: int,
+):
+    jbase = pl.program_id(1) * COLS_PER_STEP
+    tlen = tlen_ref[0, 0]
+
+    off = off_ref[0, :]
+    slen = slen_ref[0, :]
+    nd = nd_ref[0, :]
+    d = jax.lax.broadcasted_iota(jnp.int32, (K, LANES), 0)
+    neg = jnp.full((K, LANES), NEG_INF, jnp.float32)
+
+    @pl.when(jbase == 0)
+    def _():
+        acc_score[:] = jnp.full((1, LANES), NEG_INF, jnp.float32)
+
+    for c in range(COLS_PER_STEP):
+        j = jbase + c
+        i = d + (j - off)[None, :]
+        valid = (i >= 0) & (i <= slen[None, :]) & (d < nd[None, :]) & (j <= tlen)
+
+        win = pl.ds(j + K, K)
+        mw = match_ref[win, :]
+        mmw = mismatch_ref[win, :]
+        insw = ins_ref[win, :]
+        delw = dels_ref[win, :]
+        seqw = seq_ref[win, :]
+
+        tb = t_ref[j, 0]  # template stored shifted: row j holds t[j-1]
+        msc = jnp.where(seqw == tb, mw, mmw)
+
+        prev = carry[:]
+        mcand = jnp.where((i >= 1) & (j >= 1), prev + msc, neg)
+        prev_up = pltpu.roll(prev, K - 1, axis=0)  # prev_up[d] = prev[d+1]
+        prev_up = jnp.where(d == K - 1, neg, prev_up)
+        dcand = jnp.where(j >= 1, prev_up + delw, neg)
+        cand = jnp.maximum(mcand, dcand)
+        # column 0: only the (0, 0) cell seeds the recurrence
+        cand = jnp.where((j == 0) & (i == 0), 0.0, cand)
+        cand = jnp.where(valid, cand, neg)
+
+        g = jnp.where((i >= 1) & valid, insw, 0.0)
+        G = _cumop(g, lambda a, b: a + b, K)
+        F = G + _cumop(cand - G, jnp.maximum, K)
+        F = jnp.where(valid, F, neg)
+
+        carry[:] = F
+        out_ref[c * K : (c + 1) * K, :] = F
+
+        # record the final score when this column is the last true column
+        @pl.when(j == tlen)
+        def _():
+            dend = dend_ref[0, :]
+            sel = jnp.where(d == dend[None, :], F, NEG_INF)
+            acc_score[:] = jnp.max(sel, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(1) == pl.num_programs(1) - 1)
+    def _():
+        score_ref[:] = acc_score[:]
+
+
+def _prep_tables(batch: ReadBatch, geom: BandGeometry, K: int, NB: int,
+                 Lbuf: int):
+    """Host-side table shifting: read k's entry for DP row index r lands at
+    buffer row `base_k + r` with base_k chosen so the column-j window is
+    rows [j + K, j + 2K) for every read."""
+    N = batch.n_reads
+    n_pad = NB * LANES
+    off = np.asarray(geom.offset)
+
+    match = np.zeros((Lbuf, n_pad), np.float32)
+    mismatch = np.zeros((Lbuf, n_pad), np.float32)
+    ins = np.zeros((Lbuf, n_pad), np.float32)
+    dels = np.zeros((Lbuf, n_pad), np.float32)
+    seq = np.full((Lbuf, n_pad), -9, np.int32)
+
+    for k in range(N):
+        n = int(batch.lengths[k])
+        # match/mismatch/ins/seq indexed by i-1 -> base = K + off + 1
+        b = K + int(off[k]) + 1
+        match[b : b + n, k] = batch.match[k, :n]
+        mismatch[b : b + n, k] = batch.mismatch[k, :n]
+        ins[b : b + n, k] = batch.ins[k, :n]
+        seq[b : b + n, k] = batch.seq[k, :n]
+        # dels indexed by i -> base = K + off
+        b2 = K + int(off[k])
+        dels[b2 : b2 + n + 1, k] = batch.dels[k, : n + 1]
+
+    meta = np.zeros((4, 1, n_pad), np.int32)
+    meta[0, 0, :N] = off
+    meta[1, 0, :N] = np.asarray(geom.slen)
+    meta[2, 0, :N] = np.asarray(geom.nd)
+    meta[3, 0, :N] = np.maximum(np.asarray(geom.slen) - np.asarray(geom.tlen), 0) + np.asarray(
+        geom.bandwidth
+    )
+    return match, mismatch, ins, dels, seq, meta
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "T1", "NB", "Lbuf", "interpret")
+)
+def _forward_call(
+    tlen_s,
+    t,
+    match,
+    mismatch,
+    ins,
+    dels,
+    seq,
+    meta,
+    K: int,
+    T1: int,
+    NB: int,
+    Lbuf: int,
+    interpret: bool = False,
+):
+    n_steps = (T1 + COLS_PER_STEP - 1) // COLS_PER_STEP
+    grid = (NB, n_steps)
+
+    def tab_spec():
+        return pl.BlockSpec(
+            (Lbuf, LANES), lambda nb, j: (0, nb), memory_space=pltpu.VMEM
+        )
+
+    # meta rows are separate inputs sliced from one [4, 1, n_pad] array
+    metas = [meta[r] for r in range(4)]
+
+    out_band, scores = pl.pallas_call(
+        functools.partial(_forward_kernel, K=K),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda nb, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((t.shape[0], 1), lambda nb, j: (0, 0), memory_space=pltpu.VMEM),
+            tab_spec(),
+            tab_spec(),
+            tab_spec(),
+            tab_spec(),
+            tab_spec(),
+            pl.BlockSpec((1, LANES), lambda nb, j: (0, nb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, LANES), lambda nb, j: (0, nb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, LANES), lambda nb, j: (0, nb), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, LANES), lambda nb, j: (0, nb), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (COLS_PER_STEP * K, LANES),
+                lambda nb, j: (j, nb),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, LANES), lambda nb, j: (0, nb), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(
+                (n_steps * COLS_PER_STEP * K, NB * LANES), jnp.float32
+            ),
+            jax.ShapeDtypeStruct((1, NB * LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K, LANES), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        tlen_s,
+        t,
+        match,
+        mismatch,
+        ins,
+        dels,
+        seq,
+        metas[0],
+        metas[1],
+        metas[2],
+        metas[3],
+    )
+    return out_band, scores
+
+
+def forward_batch_pallas(
+    template: np.ndarray,
+    batch: ReadBatch,
+    tlen: Optional[int] = None,
+    K: Optional[int] = None,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, BandGeometry]:
+    """Pallas banded forward fill. Returns (bands [N, K, T+1], scores [N],
+    geometry), matching align_jax.forward_batch's band layout."""
+    from .align_jax import band_height
+
+    if tlen is None:
+        tlen = len(template)
+    if K is None:
+        K = band_height(batch, tlen)
+    K = max(((K + 7) // 8) * 8, 8)  # f32 block sublane divisibility
+    geom = batch_geometry(batch, tlen)
+    NB = (batch.n_reads + LANES - 1) // LANES
+    T1 = len(template) + 1
+    n_steps = (T1 + COLS_PER_STEP - 1) // COLS_PER_STEP
+    T1p = n_steps * COLS_PER_STEP
+    Lbuf = ((max(batch.max_len, T1p) + 2 * K + 8 + 7) // 8) * 8
+    match, mismatch, ins, dels, seq, meta = _prep_tables(batch, geom, K, NB, Lbuf)
+    t = np.full((T1p, 1), -1, np.int32)
+    # t_ref row j holds t[j-1] (row 0 unused)
+    t[1:T1, 0] = np.asarray(template, np.int32)[: T1 - 1]
+    tlen_s = np.array([[tlen]], np.int32)
+    band_flat, scores = _forward_call(
+        tlen_s, t, match, mismatch, ins, dels, seq, meta,
+        K=K, T1=T1, NB=NB, Lbuf=Lbuf, interpret=interpret,
+    )
+    # [T1p*K, NB*128] -> [N, K, T1]
+    band = band_flat[: T1 * K].reshape(T1, K, NB * LANES)[:, :, : batch.n_reads]
+    band = jnp.transpose(band, (2, 1, 0))
+    return band, scores[0, : batch.n_reads], geom

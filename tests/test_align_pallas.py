@@ -1,0 +1,36 @@
+"""Pallas forward kernel vs the XLA scan path (interpret mode on CPU)."""
+
+import numpy as np
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax
+from rifraf_tpu.ops.align_pallas import forward_batch_pallas
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0))
+
+
+def test_pallas_forward_matches_xla():
+    rng = np.random.default_rng(0)
+    tlen = 33
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for slen in (30, 33, 37, 25):
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -1.0, size=slen)
+        reads.append(make_read_scores(s, log_p, 6, SCORES))
+    batch = batch_reads(reads, dtype=np.float32)
+
+    bandsP, scoresP, geomP = forward_batch_pallas(template, batch, interpret=True)
+    K = bandsP.shape[1]
+    bandsX, _, scoresX, _ = align_jax.forward_batch(template, batch, K=K)
+
+    np.testing.assert_allclose(
+        np.asarray(scoresP), np.asarray(scoresX), rtol=1e-4, atol=1e-4
+    )
+    bp = np.asarray(bandsP)
+    bx = np.asarray(bandsX)
+    finite = np.isfinite(bx) & (bp > -1e30)
+    np.testing.assert_allclose(bp[finite], bx[finite], rtol=1e-4, atol=1e-4)
+    # out-of-band cells are "minus infinity" in both representations
+    assert (bp[~np.isfinite(bx)] < -1e30).all()

@@ -96,6 +96,30 @@ def _log(params: RifrafParams, level: int, msg: str) -> None:
         print(msg, file=sys.stderr)
 
 
+_cache_enabled = False
+
+
+def _enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache: the engine's bucketed shapes form a
+    small, stable executable set, so repeated runs skip compilation."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    import jax
+
+    try:
+        cache_dir = os.environ.get(
+            "RIFRAF_TPU_CACHE", os.path.expanduser("~/.cache/rifraf_tpu_xla")
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+
 def initial_state(
     consensus: Optional[np.ndarray],
     sequences: List[ReadScores],
@@ -489,6 +513,7 @@ def rifraf(
     """
     from ..utils.constants import encode_seq
 
+    _enable_compilation_cache()
     if params is None:
         params = RifrafParams()
     dnaseqs = [encode_seq(s) if isinstance(s, str) else np.asarray(s, np.int8)

@@ -193,7 +193,7 @@ class RefAligner:
                 max_bw = ref.bandwidth
             n_errors = old_n_errors = np.iinfo(np.int64).max
             while True:
-                self.A, self.Amoves = align_np.forward_moves(consensus, ref)
+                self.A, self.Amoves = align_np.forward_moves_vec(consensus, ref)
                 if ref.bandwidth_fixed or ref.bandwidth >= max_bw:
                     break
                 old_n_errors = n_errors
@@ -205,7 +205,7 @@ class RefAligner:
                     break
             ref.bandwidth_fixed = True
         if realign_Bs:
-            self.B = align_np.backward(consensus, ref)
+            self.B = align_np.backward_vec(consensus, ref)
 
     def score(self) -> float:
         return float(self.A[self.A.nrows - 1, self.A.ncols - 1])

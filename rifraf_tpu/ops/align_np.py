@@ -162,6 +162,175 @@ def forward_moves(
     return result, moves
 
 
+def _chain1(cand: np.ndarray, g1: np.ndarray) -> np.ndarray:
+    """Resolve F[r] = max(cand[r], F[r-1] + g1[r]) in closed form:
+    F = G + cummax(cand - G) with G = cumsum(g1) (max-plus semiring)."""
+    G = np.cumsum(g1)
+    with np.errstate(invalid="ignore"):
+        return G + np.maximum.accumulate(cand - G)
+
+
+def _shift_down(x: np.ndarray, k: int, fill=-np.inf) -> np.ndarray:
+    """out[r] = x[r-k]."""
+    out = np.full_like(x, fill)
+    if k < len(x):
+        out[k:] = x[:-k] if k > 0 else x
+    return out
+
+
+def forward_moves_vec(
+    t: np.ndarray,
+    s: ReadScores,
+    trim: bool = False,
+    skew_matches: bool = False,
+    want_moves: bool = True,
+    doreverse: bool = False,
+) -> Tuple[BandedArray, Optional[BandedArray]]:
+    """Column-vectorized banded forward DP, codon-capable.
+
+    Semantically equal to the cell loop (forward_moves_inplace /
+    align.jl:114-179) up to fp reassociation. Within-column insert chains
+    use the max-plus closed form; codon-insert chains (distance-3 edges)
+    are resolved by iterating chain1 with the distance-3 relaxation to a
+    fixpoint — each pass extends optimal paths by at least one codon-insert
+    edge, so convergence is exact.
+
+    This is the production host path for consensus-vs-reference alignments
+    (each column is one numpy vector op instead of a Python cell loop).
+    """
+    rs = s.reversed() if doreverse else s
+    t_eff = np.asarray(t)[::-1] if doreverse else np.asarray(t)
+    shape = (len(rs) + 1, len(t_eff) + 1)
+    nrows, ncols = shape
+    A = BandedArray(shape, rs.bandwidth, default=-np.inf)
+    A.data.fill(-np.inf)
+    moves = None
+    if want_moves:
+        moves = BandedArray(shape, rs.bandwidth, default=TRACE_NONE, dtype=np.int8)
+        moves.data.fill(TRACE_NONE)
+
+    seq = rs.seq
+    match_sc = rs.match_scores
+    mismatch_sc = rs.mismatch_scores * 0.99 if skew_matches else rs.mismatch_scores
+    ins_sc = rs.ins_scores
+    del_sc = rs.del_scores
+    do_cins = rs.do_codon_ins and len(rs.codon_ins_scores) > 0
+    do_cdel = rs.do_codon_del
+    cins_sc = rs.codon_ins_scores
+    cdel_sc = rs.codon_del_scores
+
+    # per-column band state: values + row offsets of up to 3 previous cols
+    prev: List[Tuple[int, np.ndarray]] = []
+    neg = -np.inf
+    for j in range(ncols):
+        start, stop = A.row_range(j)
+        i = np.arange(start, stop + 1)
+        n = len(i)
+
+        def from_col(col_idx: int, row_shift: int) -> np.ndarray:
+            """Values of column col_idx at rows i - row_shift, -inf outside."""
+            if col_idx < 0 or j - col_idx > len(prev):
+                return np.full(n, neg)
+            pstart, pvals = prev[col_idx - j]  # prev[-1] is column j-1
+            out = np.full(n, neg)
+            rows = i - row_shift
+            lo = max(rows[0], pstart)
+            hi = min(rows[-1], pstart + len(pvals) - 1)
+            if lo > hi:
+                return out
+            out[lo - rows[0] : hi - rows[0] + 1] = pvals[lo - pstart : hi - pstart + 1]
+            return out
+
+        if j == 0:
+            cand = np.where(i == 0, 0.0, neg)
+            mcand = dcand = cdel_cand = np.full(n, neg)
+        else:
+            tb = t_eff[j - 1]
+            si = np.clip(i - 1, 0, len(seq) - 1)
+            sb = seq[si]
+            msc = np.where(sb == tb, match_sc[si], mismatch_sc[si])
+            mcand = np.where(i >= 1, from_col(j - 1, 1) + msc, neg)
+            dcand = from_col(j - 1, 0) + del_sc[np.clip(i, 0, len(del_sc) - 1)]
+            cand = np.maximum(mcand, dcand)
+            if do_cdel and j >= CODON_LENGTH:
+                cdel_cand = from_col(j - CODON_LENGTH, 0) + cdel_sc[
+                    np.clip(i, 0, len(cdel_sc) - 1)
+                ]
+                cand = np.maximum(cand, cdel_cand)
+            else:
+                cdel_cand = np.full(n, neg)
+
+        g1 = np.where(i >= 1, ins_sc[np.clip(i - 1, 0, len(ins_sc) - 1)], 0.0)
+        if trim and (j == 0 or j == ncols - 1):
+            g1 = np.where(i >= 1, 0.0, g1)
+        if do_cins:
+            g3 = np.where(
+                i >= CODON_LENGTH,
+                cins_sc[np.clip(i - CODON_LENGTH, 0, len(cins_sc) - 1)],
+                neg,
+            )
+        F = _chain1(cand, g1)
+        if do_cins:
+            # fixpoint over distance-3 codon-insert edges; each pass extends
+            # optimal paths by >= 1 such edge, so this terminates exactly
+            for _ in range(n // CODON_LENGTH + 1):
+                relaxed = np.maximum(cand, _shift_down(F, CODON_LENGTH) + g3)
+                F2 = _chain1(relaxed, g1)
+                with np.errstate(invalid="ignore"):
+                    improved = bool(np.any(F2 > F))
+                F = np.maximum(F, F2)
+                if not improved:
+                    break
+
+        A.data[A.data_row(start, j) : A.data_row(stop, j) + 1, j] = F
+        if want_moves:
+            ins_real = _shift_down(F, 1) + g1
+            stacked = [mcand, ins_real, dcand]
+            codes = [TRACE_MATCH, TRACE_INSERT, TRACE_DELETE]
+            if do_cins:
+                stacked.append(_shift_down(F, CODON_LENGTH) + g3)
+                codes.append(TRACE_CODON_INSERT)
+            stacked.append(cdel_cand)
+            codes.append(TRACE_CODON_DELETE)
+            # cell (0, 0) and out-of-band stay TRACE_NONE
+            best = np.argmax(np.stack(stacked), axis=0)
+            mv = np.array(codes, dtype=np.int8)[best]
+            finite = np.isfinite(F)
+            mv = np.where(finite, mv, TRACE_NONE)
+            if j == 0:
+                mv = np.where(i == 0, TRACE_NONE, mv)
+            moves.data[
+                moves.data_row(start, j) : moves.data_row(stop, j) + 1, j
+            ] = mv
+
+        prev.append((start, F))
+        if len(prev) > CODON_LENGTH:
+            prev.pop(0)
+    return A, moves
+
+
+def forward_vec(
+    t: np.ndarray,
+    s: ReadScores,
+    doreverse: bool = False,
+    trim: bool = False,
+    skew_matches: bool = False,
+) -> BandedArray:
+    """Vectorized forward fill without moves."""
+    A, _ = forward_moves_vec(
+        t, s, trim=trim, skew_matches=skew_matches, want_moves=False,
+        doreverse=doreverse,
+    )
+    return A
+
+
+def backward_vec(t: np.ndarray, s: ReadScores) -> BandedArray:
+    """Vectorized backward DP (forward on reversed + flip, align.jl:196-202)."""
+    A = forward_vec(t, s, doreverse=True)
+    A.flip()
+    return A
+
+
 def forward_inplace(
     t: np.ndarray,
     s: ReadScores,
@@ -306,7 +475,7 @@ def count_errors_in_moves(moves_arr: BandedArray, t: np.ndarray, s: np.ndarray) 
 
 def count_errors(t: np.ndarray, s: ReadScores) -> int:
     """align.jl:247-250."""
-    _, amoves = forward_moves(t, s, skew_matches=True)
+    _, amoves = forward_moves_vec(t, s, skew_matches=True)
     return count_errors_in_moves(amoves, t, s.seq)
 
 
@@ -319,7 +488,7 @@ def edit_distance(t: np.ndarray, s: np.ndarray) -> int:
     bandwidth = int(np.ceil(min(len(t), len(s)) * 0.5))
     scores = Scores.from_error_model(ErrorModel(1.0, 1.0, 1.0))
     seq = make_read_scores(s, log_ps, max(bandwidth, 1), scores)
-    _, amoves = forward_moves(t, seq, skew_matches=True)
+    _, amoves = forward_moves_vec(t, seq, skew_matches=True)
     return count_errors_in_moves(amoves, t, s)
 
 
@@ -348,7 +517,7 @@ def align_moves(
     t: np.ndarray, s: ReadScores, trim: bool = False, skew_matches: bool = False
 ) -> List[int]:
     """align.jl:337-344."""
-    _, amoves = forward_moves(t, s, trim=trim, skew_matches=skew_matches)
+    _, amoves = forward_moves_vec(t, s, trim=trim, skew_matches=skew_matches)
     return backtrace(amoves)
 
 

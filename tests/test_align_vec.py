@@ -1,0 +1,95 @@
+"""Vectorized numpy engine vs the cell-by-cell oracle (codon-capable)."""
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.ops import align_np
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 5.0, 5.0))
+CODON_SCORES = Scores.from_error_model(ErrorModel(2.0, 0.5, 0.5, 3.0, 3.0))
+
+
+def random_case(rng, slen, tlen, bw, scores):
+    t = rng.integers(0, 4, size=tlen).astype(np.int8)
+    s = rng.integers(0, 4, size=slen).astype(np.int8)
+    log_p = rng.uniform(-3.0, -0.5, size=slen)
+    return t, make_read_scores(s, log_p, bw, scores)
+
+
+@pytest.mark.parametrize("use_codon", [False, True])
+@pytest.mark.parametrize("trim,skew", [(False, False), (True, False), (False, True)])
+def test_forward_vec_matches_cell_loop(use_codon, trim, skew):
+    rng = np.random.default_rng(11 + use_codon)
+    scores = CODON_SCORES if use_codon else SCORES
+    for _ in range(8):
+        slen = int(rng.integers(5, 40))
+        tlen = int(rng.integers(5, 40))
+        bw = int(rng.integers(3, 10))
+        t, rs = random_case(rng, slen, tlen, bw, scores)
+        want = align_np.forward(t, rs, trim=trim, skew_matches=skew)
+        got = align_np.forward_vec(t, rs, trim=trim, skew_matches=skew)
+        np.testing.assert_allclose(
+            got.dense(default=-np.inf),
+            want.dense(default=-np.inf),
+            rtol=1e-9, atol=1e-9,
+            err_msg=f"slen={slen} tlen={tlen} bw={bw} codon={use_codon}",
+        )
+
+
+@pytest.mark.parametrize("use_codon", [False, True])
+def test_backward_vec_matches_cell_loop(use_codon):
+    rng = np.random.default_rng(23 + use_codon)
+    scores = CODON_SCORES if use_codon else SCORES
+    for _ in range(5):
+        slen = int(rng.integers(5, 35))
+        tlen = int(rng.integers(5, 35))
+        t, rs = random_case(rng, slen, tlen, 6, scores)
+        want = align_np.backward(t, rs)
+        got = align_np.backward_vec(t, rs)
+        np.testing.assert_allclose(
+            got.dense(default=-np.inf),
+            want.dense(default=-np.inf),
+            rtol=1e-9, atol=1e-9,
+        )
+
+
+@pytest.mark.parametrize("use_codon", [False, True])
+def test_moves_vec_produce_optimal_paths(use_codon):
+    """Traceback from the vectorized move matrix is a complete optimal path
+    (moves may differ from the cell loop only at exact ties)."""
+    rng = np.random.default_rng(37 + use_codon)
+    scores = CODON_SCORES if use_codon else SCORES
+    for _ in range(6):
+        slen = int(rng.integers(8, 30))
+        tlen = int(rng.integers(8, 30))
+        t, rs = random_case(rng, slen, tlen, 6, scores)
+        A, moves = align_np.forward_moves_vec(t, rs)
+        path = align_np.backtrace(moves)
+        at, as_ = align_np.moves_to_aligned_seqs(path, t, rs.seq)
+        assert (as_[as_ >= 0] == rs.seq).all()
+        assert (at[at >= 0] == t).all()
+        # replay the path score; must equal the DP total
+        total = 0.0
+        i = j = 0
+        for m in path:
+            di, dj = align_np.OFFSETS[m]
+            i, j = i + di, j + dj
+            if m == align_np.TRACE_MATCH:
+                total += (
+                    rs.match_scores[i - 1]
+                    if rs.seq[i - 1] == t[j - 1]
+                    else rs.mismatch_scores[i - 1]
+                )
+            elif m == align_np.TRACE_INSERT:
+                total += rs.ins_scores[i - 1]
+            elif m == align_np.TRACE_DELETE:
+                total += rs.del_scores[i]
+            elif m == align_np.TRACE_CODON_INSERT:
+                total += rs.codon_ins_scores[i - 3]
+            elif m == align_np.TRACE_CODON_DELETE:
+                total += rs.codon_del_scores[i]
+        np.testing.assert_allclose(
+            total, A[slen, tlen], rtol=1e-9, atol=1e-9
+        )

@@ -236,11 +236,23 @@ def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
         state.realign_As = True
         state.realign_Bs = True
     _log(params, 2, f"    realigning As={state.realign_As} Bs={state.realign_Bs}")
+    # tracebacks (the moves band) are only needed for alignment-derived
+    # proposals, quality estimation, and bandwidth adaptation — skip the
+    # device->host move transfer otherwise (e.g. FRAME iterations)
+    want_moves = (
+        (
+            state.stage in (Stage.INIT, Stage.REFINE)
+            and params.do_alignment_proposals
+        )
+        or state.stage == Stage.SCORE
+        or not state.aligner.fixed.all()
+    )
     state.aligner.realign(
         state.consensus,
         params.bandwidth_pvalue,
         realign_As=state.realign_As,
         realign_Bs=state.realign_Bs,
+        want_moves=want_moves,
     )
     uref = use_ref(state, params.use_ref_for_qvs)
     if uref:

@@ -155,15 +155,32 @@ class BatchAligner:
         return float(np.dot(weights, self.scores))
 
     # --- proposal scoring -------------------------------------------------
+    # cap on reads x proposals per launch: keeps the [N, K, P] scoring
+    # intermediates within a fraction of HBM and the XLA program small
+    MAX_SCORE_ELEMS = 2048 * 2048
+
     def score_proposals(self, proposals: Sequence[Proposal]) -> np.ndarray:
-        """Total score of each proposal across the batch, one device launch
-        (the reference's per-proposal-per-read host loop, model.jl:385-399)."""
-        per_read = np.asarray(
-            score_proposals_batch(
-                self.A_bands, self.B_bands, self._current_batch(), self.geom, proposals
+        """Total score of each proposal across the batch, in as few device
+        launches as memory allows (the reference's per-proposal-per-read
+        host loop, model.jl:385-399)."""
+        n = len(self.reads)
+        chunk = max(128, self.MAX_SCORE_ELEMS // max(n, 1))
+        batch = self._current_batch()
+        if len(proposals) <= chunk:
+            per_read = np.asarray(
+                score_proposals_batch(
+                    self.A_bands, self.B_bands, batch, self.geom, proposals
+                )
             )
-        )
-        return per_read.sum(axis=0)
+            return per_read.sum(axis=0)
+        outs = []
+        for s in range(0, len(proposals), chunk):
+            per_read = score_proposals_batch(
+                self.A_bands, self.B_bands, batch, self.geom,
+                proposals[s : s + chunk], pad_bucket=chunk,
+            )
+            outs.append(np.asarray(per_read).sum(axis=0))
+        return np.concatenate(outs)
 
     def export_bandwidths(self) -> None:
         """Write adapted bandwidths back into the ReadScores objects so

@@ -344,13 +344,14 @@ def traceback_batch(
     else:
         tl = tlen.astype(np.int64)
     j = tl.copy()
-    out = [[] for _ in range(N)]
     count = seqs is not None and template is not None
     n_errors = np.zeros(N, dtype=np.int64)
     if max_steps is None:
         max_steps = int((slen + tl).max()) + 1
     rows = np.arange(N)
-    for _ in range(max_steps):
+    taken = np.zeros((N, max_steps), dtype=np.int8)
+    lengths = np.zeros(N, dtype=np.int64)
+    for step in range(max_steps):
         active = (i > 0) | (j > 0)
         if not active.any():
             break
@@ -360,18 +361,18 @@ def traceback_batch(
         bad = active & (m == TRACE_NONE)
         if bad.any():
             raise RuntimeError(f"traceback hit TRACE_NONE for reads {np.nonzero(bad)[0]}")
-        for n in np.nonzero(active)[0]:
-            out[n].append(int(m[n]))
+        taken[:, step] = m
+        lengths += active
         if count:
             sb = seqs[rows, np.clip(i - 1, 0, seqs.shape[1] - 1)]
             tb = template[np.clip(j - 1, 0, len(template) - 1)]
             mism = (m == TRACE_MATCH) & (sb != tb)
             n_errors += active & (mism | (m == TRACE_INSERT) | (m == TRACE_DELETE))
-        di = np.where(m == TRACE_MATCH, 1, 0) + np.where(m == TRACE_INSERT, 1, 0)
-        dj = np.where(m == TRACE_MATCH, 1, 0) + np.where(m == TRACE_DELETE, 1, 0)
+        di = (m == TRACE_MATCH) + (m == TRACE_INSERT)
+        dj = (m == TRACE_MATCH) + (m == TRACE_DELETE)
         i = i - di * active
         j = j - dj * active
-    paths = [ops[::-1] for ops in out]
+    paths = [taken[n, : lengths[n]][::-1].tolist() for n in range(N)]
     if count:
         return paths, n_errors
     return paths

@@ -65,6 +65,9 @@ class RifrafState:
     ref_error_rate: float = -np.inf
     n_ref_indel_mults: int = 0
     batch_seqs: List[ReadScores] = field(default_factory=list)
+    # whether the reference's score vectors have been built from a real
+    # error-rate estimate (initial_state only makes a placeholder)
+    ref_built: bool = False
     realign_As: bool = True
     realign_Bs: bool = True
     penalties_increased: bool = False
@@ -178,6 +181,24 @@ def use_ref(state: RifrafState, use_ref_for_qvs: bool) -> bool:
     return False
 
 
+def _build_reference_scores(state: RifrafState, params: RifrafParams) -> None:
+    """Estimate the reference error rate from the consensus edit distance
+    and build the real per-base score vectors (the INIT->FRAME edge,
+    model.jl:946-962). Also invoked lazily if a stage needs the reference
+    before FRAME ever ran (e.g. do_frame=False with use_ref_for_qvs=True):
+    the placeholder built by initial_state must never be scored against."""
+    edit_dist = align_np.edit_distance(state.consensus, state.reference.seq)
+    ref_error_rate = edit_dist / max(len(state.reference), len(state.consensus))
+    ref_error_rate *= params.ref_error_mult
+    # needs to be < 0.5, otherwise matches aren't rewarded at all
+    state.ref_error_rate = min(max(ref_error_rate, 1e-10), 0.5)
+    ref_error_log_p = np.full(len(state.reference), np.log10(state.ref_error_rate))
+    state.reference = make_read_scores(
+        state.reference.seq, ref_error_log_p, params.bandwidth, state.ref_scores
+    )
+    state.ref_built = True
+
+
 def reweight(wv: np.ndarray, n: int, randomness: float) -> np.ndarray:
     """Interpolate between top-n / error-proportional / uniform weights
     (model.jl:1017-1036)."""
@@ -222,9 +243,22 @@ def resample(state: RifrafState, params: RifrafParams, rng: np.random.Generator)
         _log(params, 2, "    sampled all sequences")
 
 
+def _same_batch(aligner: Optional[BatchAligner], batch_seqs: List[ReadScores]) -> bool:
+    """Membership (and order) comparison of the aligner's cached batch vs
+    the freshly resampled one. `resample` rebuilds the list object every
+    iteration even when the selection is unchanged, so identity of the list
+    would always miss — defeating the realign_As=False fast path after a
+    single-candidate accept (model.jl:928-930)."""
+    return (
+        aligner is not None
+        and len(aligner.reads) == len(batch_seqs)
+        and all(a is b for a, b in zip(aligner.reads, batch_seqs))
+    )
+
+
 def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
     """realign! + rescore! (model.jl:630-719), batched on device."""
-    if state.aligner is None or state.aligner.reads is not state.batch_seqs:
+    if not _same_batch(state.aligner, state.batch_seqs):
         if state.aligner is not None:
             state.aligner.export_bandwidths()
         if state.aligner is None:
@@ -256,6 +290,8 @@ def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
     )
     uref = use_ref(state, params.use_ref_for_qvs)
     if uref:
+        if not state.ref_built:
+            _build_reference_scores(state, params)
         if state.ref_aligner is None:
             state.ref_aligner = RefAligner()
         state.ref_aligner.realign(
@@ -378,20 +414,7 @@ def finish_stage(state: RifrafState, params: RifrafParams) -> None:
             state.converged = True
         else:
             state.stage = Stage.FRAME
-            edit_dist = align_np.edit_distance(state.consensus, state.reference.seq)
-            ref_error_rate = edit_dist / max(
-                len(state.reference), len(state.consensus)
-            )
-            ref_error_rate *= params.ref_error_mult
-            # needs to be < 0.5, otherwise matches aren't rewarded at all
-            state.ref_error_rate = min(max(ref_error_rate, 1e-10), 0.5)
-            ref_error_log_p = np.full(
-                len(state.reference), np.log10(state.ref_error_rate)
-            )
-            state.reference = make_read_scores(
-                state.reference.seq, ref_error_log_p, params.bandwidth,
-                state.ref_scores,
-            )
+            _build_reference_scores(state, params)
             if not has_single_indels(state.consensus, state.reference):
                 state.converged = True
     elif state.stage == Stage.FRAME:

@@ -115,5 +115,5 @@ def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> No
         raise ValueError("batch_randomness must be between 0.0 and 1.0")
     if not (0.0 <= params.batch_mult <= 1.0):
         raise ValueError("batch_mult must be between 0.0 and 1.0")
-    if params.batch_threshold < 0.0 or params.batch_mult > 1.0:
+    if not (0.0 <= params.batch_threshold <= 1.0):
         raise ValueError("batch_threshold must be between 0.0 and 1.0")

@@ -48,6 +48,7 @@ class BatchAligner:
     def __init__(self, reads: Sequence[ReadScores], dtype=np.float64, len_bucket: int = 64):
         self.dtype = np.dtype(dtype)
         self.len_bucket = int(len_bucket)
+        self.n_forward_fills = 0  # diagnostic: counts device forward launches
         self.set_batch(list(reads))
         self.A_bands = None
         self.B_bands = None
@@ -98,9 +99,15 @@ class BatchAligner:
         tlen = len(consensus)
         if realign_As:
             self._old_errors = np.full(len(self.reads), np.iinfo(np.int64).max)
+            # cap is computed ONCE from the bandwidths at entry
+            # (model.jl:650: seq.bandwidth * 2^5); recomputing from the
+            # already-doubled value each round would let a read grow past
+            # the final refill, leaving A and B with mismatched band heights
+            entry_bw = self.bandwidths.copy()
             for _round in range(MAX_BANDWIDTH_DOUBLINGS + 1):
                 batch = self._current_batch()
                 K = self._K(tlen)
+                self.n_forward_fills += 1
                 bands, moves, scores, geom = align_jax.forward_batch(
                     t, batch, tlen=tlen, K=K, want_moves=want_moves
                 )
@@ -115,7 +122,7 @@ class BatchAligner:
                 self.tracebacks = paths
                 if self.fixed.all():
                     break
-                grew = self._maybe_grow_bandwidth(n_errors, tlen, pvalue)
+                grew = self._maybe_grow_bandwidth(n_errors, tlen, pvalue, entry_bw)
                 if not grew:
                     self.fixed[:] = True
                     break
@@ -126,7 +133,8 @@ class BatchAligner:
             self.B_bands = B_bands
             self.geom = geom
 
-    def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float) -> bool:
+    def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float,
+                              entry_bw: np.ndarray) -> bool:
         """Double bandwidths of reads whose alignments look band-limited
         (model.jl:655-671). Returns True if any bandwidth grew."""
         grew = False
@@ -134,7 +142,7 @@ class BatchAligner:
             if self.fixed[k]:
                 continue
             slen = int(self.batch.lengths[k])
-            max_bw = min(int(self.bandwidths[k]) << MAX_BANDWIDTH_DOUBLINGS, tlen, slen)
+            max_bw = min(int(entry_bw[k]) << MAX_BANDWIDTH_DOUBLINGS, tlen, slen)
             threshold = poisson_cquantile(self.est_n_errors[k], pvalue)
             if (
                 n_errors[k] > threshold

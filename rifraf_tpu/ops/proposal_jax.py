@@ -23,7 +23,7 @@ Proposal encoding: ptype 0=substitution, 1=insertion, 2=deletion
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

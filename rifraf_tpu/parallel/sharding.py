@@ -77,6 +77,16 @@ def pad_batch_to(batch: ReadBatch, n: int) -> Tuple[ReadBatch, np.ndarray]:
     return out, w
 
 
+def weighted_read_sum(weights, values):
+    """Sum weight*value over the leading (read) axis, neutralizing
+    zero-weight padding rows by masking on the WEIGHT — not on finiteness
+    of the value. A real read's legitimate -inf score must propagate (an
+    impossible proposal must rank below every valid one), while padding
+    rows contribute exactly 0 even when their values are -inf/nan."""
+    w = weights.reshape(weights.shape + (1,) * (values.ndim - 1))
+    return jnp.sum(jnp.where(w > 0, w * values, 0.0), axis=0)
+
+
 def _consensus_step(
     template,
     seq,
@@ -109,9 +119,8 @@ def _consensus_step(
         _score_one_read, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, None)
     )
     pscores = score_fn(A, B, seq, match, mismatch, ins, dels, geom, ptype, ppos, pbase)
-    total = jnp.sum(weights * scores)  # -> psum over the sharded read axis
-    masked = jnp.where(jnp.isfinite(pscores), pscores, 0.0)
-    proposal_totals = jnp.sum(weights[:, None] * masked, axis=0)
+    total = weighted_read_sum(weights, scores)
+    proposal_totals = weighted_read_sum(weights, pscores)
     return total, proposal_totals
 
 

@@ -176,3 +176,115 @@ def test_initial_consensus_is_best_read():
 def test_rifraf_requires_error_info():
     with pytest.raises(ValueError):
         rifraf([encode_seq("ACGT")])
+
+
+def _noisy_reads(n=6, length=120, seed=11, error_rate=0.02):
+    rng = np.random.default_rng(seed)
+    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=n, length=length, error_rate=error_rate, rng=rng
+    )
+    reads = [
+        make_read_scores(s, phred_to_log_p(np.asarray(p, float)), 9, SEQ_SCORES)
+        for s, p in zip(seqs, phreds)
+    ]
+    return template, reads
+
+
+def test_bandwidth_cap_uses_entry_bandwidth():
+    """Regression: max_bw must be computed once from the entry bandwidth
+    (model.jl:650 caps at bandwidth*2^5), not recomputed from the
+    already-doubled value — otherwise growth can continue past the final
+    refill, leaving A and B bands at mismatched heights."""
+    from rifraf_tpu.engine.realign import MAX_BANDWIDTH_DOUBLINGS, BatchAligner
+
+    template, reads = _noisy_reads(n=2, length=400)
+    for r in reads:
+        r.bandwidth = 2
+        r.bandwidth_fixed = False
+    aligner = BatchAligner(reads)
+    entry_bw = aligner.bandwidths.copy()
+    cap = int(entry_bw[0]) << MAX_BANDWIDTH_DOUBLINGS
+    tlen = len(template)
+    # force growth every round: huge error counts, strictly decreasing
+    big = 10**6
+    for round_ in range(2 * (MAX_BANDWIDTH_DOUBLINGS + 2)):
+        aligner._old_errors = np.full(len(reads), np.iinfo(np.int64).max)
+        aligner._maybe_grow_bandwidth(
+            np.full(len(reads), big - round_), tlen, 0.1, entry_bw
+        )
+    assert (aligner.bandwidths <= cap).all(), aligner.bandwidths
+
+
+def test_bandwidth_growth_never_outruns_final_refill():
+    """After realign() the A and B bands must always have identical band
+    heights, even when bandwidth adaptation maxes out its doublings."""
+    from rifraf_tpu.engine.realign import BatchAligner
+
+    template, reads = _noisy_reads(n=3, length=300, error_rate=0.15)
+    for r in reads:
+        r.bandwidth = 2
+        r.bandwidth_fixed = False
+    aligner = BatchAligner(reads)
+    aligner.realign(template, pvalue=0.1)
+    assert aligner.A_bands.shape == aligner.B_bands.shape
+    assert aligner.fixed.all()
+
+
+def test_ab_cache_skips_forward_fill_after_accept():
+    """Regression for the dead realign_As=False fast path: resample()
+    rebuilds the batch list object each iteration, so the aligner must
+    compare batch MEMBERSHIP, not list identity (model.jl:928-930's
+    skip-forward-refill optimization)."""
+    from rifraf_tpu.engine import driver as drv
+
+    template, reads = _noisy_reads(n=6, length=90)
+    params = RifrafParams(batch_fixed=True, batch_fixed_size=4)
+    state = drv.initial_state(None, reads, None, params)
+    rng = np.random.default_rng(0)
+
+    drv.resample(state, params, rng)
+    drv.realign_rescore(state, params)
+    fills = state.aligner.n_forward_fills
+    assert fills >= 1
+
+    # same membership, fresh list object; realign_As=False must skip the
+    # forward fill entirely
+    state.realign_As = False
+    state.realign_Bs = True
+    drv.resample(state, params, rng)
+    drv.realign_rescore(state, params)
+    assert state.aligner.n_forward_fills == fills
+
+
+def test_batch_threshold_validated():
+    from rifraf_tpu.engine.params import check_params
+
+    params = RifrafParams(batch_threshold=1.5)
+    with pytest.raises(ValueError, match="batch_threshold"):
+        check_params(params.scores, 0, params)
+
+
+def test_use_ref_for_qvs_without_frame_builds_reference():
+    """Regression: with do_frame=False + use_ref_for_qvs=True the SCORE
+    stage must never score against the placeholder reference built by
+    initial_state (all-zero score vectors); the real score vectors are
+    built lazily from an edit-distance error estimate."""
+    rng = np.random.default_rng(5)
+    ref, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=5, length=60, rng=rng, **SAMPLE_PARAMS
+    )
+    reference = ref
+    params = RifrafParams(
+        do_frame=False, do_score=True, use_ref_for_qvs=True,
+        ref_scores=REF_SCORES, scores=SEQ_SCORES,
+    )
+    result = rifraf(seqs, phreds=phreds, reference=reference, params=params)
+    state = result.state
+    assert state.ref_built
+    # real (negative, finite) match scores — not the placeholder zeros
+    assert np.all(state.reference.match_scores < 0.0)
+    assert np.all(np.isfinite(state.reference.match_scores))
+    assert result.error_probs is not None
+    probs = estimate_point_probs(result.error_probs)
+    assert probs.shape == (len(result.consensus),)
+    assert np.all((probs >= 0.0) & (probs <= 1.0))

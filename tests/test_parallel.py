@@ -107,3 +107,28 @@ def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_weighted_read_sum_masks_padding_not_neg_inf():
+    """Padding rows (weight 0) contribute exactly 0 even with -inf/nan
+    values; a real read's -inf proposal score must survive the reduction
+    so impossible proposals rank below valid ones."""
+    import jax.numpy as jnp
+
+    from rifraf_tpu.parallel.sharding import weighted_read_sum
+
+    weights = jnp.array([1.0, 1.0, 0.0])
+    pscores = jnp.array(
+        [
+            [-1.0, -jnp.inf],
+            [-2.0, -3.0],
+            [jnp.nan, -jnp.inf],  # padding junk must not leak
+        ]
+    )
+    out = np.asarray(weighted_read_sum(weights, pscores))
+    assert out[0] == -3.0
+    assert out[1] == -np.inf
+
+    scores = jnp.array([-5.0, -7.0, jnp.nan])
+    total = float(weighted_read_sum(weights, scores))
+    assert total == -12.0

@@ -263,7 +263,8 @@ def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
             state.aligner.export_bandwidths()
         if state.aligner is None:
             state.aligner = BatchAligner(
-                state.batch_seqs, dtype=params.dtype, len_bucket=params.len_bucket
+                state.batch_seqs, dtype=params.dtype,
+                len_bucket=params.len_bucket, mesh=params.mesh,
             )
         else:
             state.aligner.set_batch(state.batch_seqs)

@@ -82,6 +82,11 @@ class RifrafParams:
     # pad template lengths up to multiples of this so consensus edits do not
     # trigger XLA recompilation
     len_bucket: int = 64
+    # optional jax.sharding.Mesh with a "reads" axis: shard the read
+    # dimension across devices so one consensus spans all chips, with
+    # XLA-inserted psum over ICI for the score reductions (replaces the
+    # reference's process-level pmap, scripts/rifraf.jl:190-191)
+    mesh: Optional[object] = None
 
 
 def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> None:

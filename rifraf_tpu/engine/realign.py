@@ -45,9 +45,18 @@ class BatchAligner:
     (the As/Bs/Amoves caches of RifrafState, model.jl:176-182).
     """
 
-    def __init__(self, reads: Sequence[ReadScores], dtype=np.float64, len_bucket: int = 64):
+    def __init__(self, reads: Sequence[ReadScores], dtype=np.float64,
+                 len_bucket: int = 64, mesh=None):
+        """`mesh`: an optional jax.sharding.Mesh with a "reads" axis. When
+        given, the read axis of every batch array is sharded across the
+        mesh, per-read DP fills run on their home devices, and the
+        proposal-score reduction over reads happens on device — XLA
+        inserts the psum over ICI. One consensus then spans all chips
+        (the BASELINE north star; replaces scripts/rifraf.jl:190-191's
+        process parallelism with collectives)."""
         self.dtype = np.dtype(dtype)
         self.len_bucket = int(len_bucket)
+        self.mesh = mesh
         self.n_forward_fills = 0  # diagnostic: counts device forward launches
         self.set_batch(list(reads))
         self.A_bands = None
@@ -61,11 +70,36 @@ class BatchAligner:
     def set_batch(self, reads: List[ReadScores]) -> None:
         self.reads = reads
         max_len = _bucket(max(len(r) for r in reads), self.len_bucket)
-        self.batch = batch_reads(reads, max_len=max_len, dtype=self.dtype)
+        batch = batch_reads(reads, max_len=max_len, dtype=self.dtype)
         # mutable per-read bandwidth state (RifrafSequence.bandwidth /
         # bandwidth_fixed, rifrafsequences.jl:15-17)
-        self.bandwidths = np.array([r.bandwidth for r in reads], dtype=np.int32)
-        self.fixed = np.array([r.bandwidth_fixed for r in reads], dtype=bool)
+        bandwidths = np.array([r.bandwidth for r in reads], dtype=np.int32)
+        fixed = np.array([r.bandwidth_fixed for r in reads], dtype=bool)
+        self.weights = None
+        self._weights_dev = None
+        self._lengths_host = np.asarray(batch.lengths)
+        if self.mesh is not None:
+            from ..parallel.sharding import pad_batch_to, shard_batch, shard_read_axis
+
+            n_dev = self.mesh.devices.size
+            n = _bucket(len(reads), n_dev)
+            batch, self.weights = pad_batch_to(batch, n)
+            pad = n - len(reads)
+            if pad:
+                # padding duplicates the last read; freeze its bandwidth so
+                # adaptation never touches it
+                bandwidths = np.concatenate(
+                    [bandwidths, np.repeat(bandwidths[-1:], pad)]
+                )
+                fixed = np.concatenate([fixed, np.ones(pad, dtype=bool)])
+            self._lengths_host = np.asarray(batch.lengths)
+            batch = shard_batch(batch, self.mesh)
+            self._weights_dev = shard_read_axis(
+                self.weights.astype(self.dtype), self.mesh
+            )
+        self.batch = batch
+        self.bandwidths = bandwidths
+        self.fixed = fixed
         self.est_n_errors = np.array([r.est_n_errors for r in reads])
         self.A_bands = None
         self.B_bands = None
@@ -81,7 +115,12 @@ class BatchAligner:
         return _bucket(align_jax.band_height(batch, tlen), 8)
 
     def _current_batch(self) -> ReadBatch:
-        return self.batch._replace(bandwidth=self.bandwidths)
+        bw = self.bandwidths
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_read_axis
+
+            bw = shard_read_axis(bw, self.mesh)
+        return self.batch._replace(bandwidth=bw)
 
     # --- alignment --------------------------------------------------------
     def realign(
@@ -141,7 +180,7 @@ class BatchAligner:
         for k in range(len(self.reads)):
             if self.fixed[k]:
                 continue
-            slen = int(self.batch.lengths[k])
+            slen = int(self._lengths_host[k])
             max_bw = min(int(entry_bw[k]) << MAX_BANDWIDTH_DOUBLINGS, tlen, slen)
             threshold = poisson_cquantile(self.est_n_errors[k], pvalue)
             if (
@@ -159,8 +198,11 @@ class BatchAligner:
     def total_score(self, weights: Optional[np.ndarray] = None) -> float:
         """Sum of per-read alignment scores (rescore!, model.jl:630-635)."""
         if weights is None:
+            weights = self.weights  # masks sharding-padding reads, if any
+        if weights is None:
             return float(np.sum(self.scores))
-        return float(np.dot(weights, self.scores))
+        # mask on weight, not value: 0 * -inf must not poison the total
+        return float(np.sum(np.where(weights > 0, weights * self.scores, 0.0)))
 
     # --- proposal scoring -------------------------------------------------
     # cap on reads x proposals per launch: keeps the [N, K, P] scoring
@@ -170,25 +212,30 @@ class BatchAligner:
     def score_proposals(self, proposals: Sequence[Proposal]) -> np.ndarray:
         """Total score of each proposal across the batch, in as few device
         launches as memory allows (the reference's per-proposal-per-read
-        host loop, model.jl:385-399)."""
-        n = len(self.reads)
+        host loop, model.jl:385-399).
+
+        Sharded path: the [N, P] per-read scores stay on device and reduce
+        over the sharded read axis (XLA psum over ICI) — only the [P]
+        totals come back to the host."""
+        n = self.batch.n_reads
         chunk = max(128, self.MAX_SCORE_ELEMS // max(n, 1))
         batch = self._current_batch()
-        if len(proposals) <= chunk:
-            per_read = np.asarray(
-                score_proposals_batch(
-                    self.A_bands, self.B_bands, batch, self.geom, proposals
-                )
-            )
-            return per_read.sum(axis=0)
         outs = []
         for s in range(0, len(proposals), chunk):
+            sub = proposals[s : s + chunk]
+            kw = {} if len(proposals) <= chunk else {"pad_bucket": chunk}
             per_read = score_proposals_batch(
-                self.A_bands, self.B_bands, batch, self.geom,
-                proposals[s : s + chunk], pad_bucket=chunk,
+                self.A_bands, self.B_bands, batch, self.geom, sub, **kw
             )
-            outs.append(np.asarray(per_read).sum(axis=0))
-        return np.concatenate(outs)
+            if self._weights_dev is not None:
+                from ..parallel.sharding import weighted_read_sum
+
+                outs.append(np.asarray(weighted_read_sum(self._weights_dev, per_read)))
+            else:
+                outs.append(np.asarray(per_read).sum(axis=0))
+        if not outs:
+            return np.empty(0, dtype=self.dtype)
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     def export_bandwidths(self) -> None:
         """Write adapted bandwidths back into the ReadScores objects so

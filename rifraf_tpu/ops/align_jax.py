@@ -249,9 +249,9 @@ def band_height(batch: ReadBatch, tlen: int, margin: int = 0) -> int:
     `margin` leaves headroom for adaptive bandwidth doubling without
     recompilation (model.jl:643-672 doubles up to 2^5).
     """
-    nd = 2 * (batch.bandwidth.astype(np.int64) + margin) + np.abs(
-        batch.lengths.astype(np.int64) - tlen
-    ) + 1
+    bw = np.asarray(batch.bandwidth).astype(np.int64)
+    lengths = np.asarray(batch.lengths).astype(np.int64)
+    nd = 2 * (bw + margin) + np.abs(lengths - tlen) + 1
     return int(nd.max())
 
 
@@ -337,6 +337,10 @@ def traceback_batch(
     slen = np.asarray(geom.slen)
     tlen = np.asarray(geom.tlen)
     offset = np.asarray(geom.offset)
+    if seqs is not None:
+        seqs = np.asarray(seqs)  # gather once; the walk below is host numpy
+    if template is not None:
+        template = np.asarray(template)
     N, K, _ = moves.shape
     i = slen.copy().astype(np.int64)
     if tlen.ndim == 0:

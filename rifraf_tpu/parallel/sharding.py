@@ -42,37 +42,37 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = READS_AXIS) -> Mesh:
     return Mesh(devices, (axis,))
 
 
+def shard_read_axis(arr, mesh: Mesh):
+    """Place one [N, ...] array with its leading (read) axis sharded over
+    the mesh."""
+    return jax.device_put(np.asarray(arr), NamedSharding(mesh, P(READS_AXIS)))
+
+
 def shard_batch(batch: ReadBatch, mesh: Mesh) -> ReadBatch:
     """Place every [N, ...] batch array with its read axis sharded over the
     mesh. N must be divisible by the mesh size (pad the batch if not)."""
-    sharding = NamedSharding(mesh, P(READS_AXIS))
-    return ReadBatch(*[jax.device_put(np.asarray(a), sharding) for a in batch])
+    return ReadBatch(*[shard_read_axis(a, mesh) for a in batch])
 
 
 def pad_batch_to(batch: ReadBatch, n: int) -> Tuple[ReadBatch, np.ndarray]:
-    """Pad the read axis to n with zero-length dummy reads; returns the
-    padded batch and a {0,1} weight vector marking real reads."""
+    """Pad the read axis to n by DUPLICATING the last real read (weight 0);
+    returns the padded batch and a {0,1} weight vector marking real reads.
+
+    Duplication (rather than zero-length dummies) keeps the static band
+    height K unchanged: a length-0 dummy's band spans ``|0 - tlen| + 3``
+    data rows, which would inflate every read's band buffer to the full
+    template length."""
     cur = batch.n_reads
     if cur >= n:
         w = np.ones(cur, dtype=np.float64)
         return batch, w
     pad = n - cur
 
-    def padded(a, fill):
-        shape = (pad,) + a.shape[1:]
-        return np.concatenate([a, np.full(shape, fill, dtype=a.dtype)])
+    def padded(a):
+        reps = np.repeat(a[-1:], pad, axis=0)
+        return np.concatenate([a, reps])
 
-    out = ReadBatch(
-        seq=padded(batch.seq, -1),
-        lengths=padded(batch.lengths, 0),
-        match=padded(batch.match, 0),
-        mismatch=padded(batch.mismatch, 0),
-        ins=padded(batch.ins, 0),
-        dels=padded(batch.dels, 0),
-        cins=padded(batch.cins, -np.inf),
-        cdel=padded(batch.cdel, -np.inf),
-        bandwidth=padded(batch.bandwidth, 1),
-    )
+    out = ReadBatch(*[padded(np.asarray(a)) for a in batch])
     w = np.concatenate([np.ones(cur), np.zeros(pad)])
     return out, w
 

@@ -265,6 +265,7 @@ def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
             state.aligner = BatchAligner(
                 state.batch_seqs, dtype=params.dtype,
                 len_bucket=params.len_bucket, mesh=params.mesh,
+                backend=params.backend,
             )
         else:
             state.aligner.set_batch(state.batch_seqs)

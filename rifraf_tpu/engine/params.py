@@ -87,6 +87,12 @@ class RifrafParams:
     # XLA-inserted psum over ICI for the score reductions (replaces the
     # reference's process-level pmap, scripts/rifraf.jl:190-191)
     mesh: Optional[object] = None
+    # alignment-fill engine: "auto" (= "xla"; the scan kernel measured
+    # fastest on available TPU hardware, see BASELINE.md), "xla", or
+    # "pallas" (on-core column sweep; float32, score-only fills,
+    # explicit opt-in). The moves-recording forward variant always
+    # uses XLA.
+    backend: str = "auto"
 
 
 def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> None:
@@ -122,3 +128,10 @@ def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> No
         raise ValueError("batch_mult must be between 0.0 and 1.0")
     if not (0.0 <= params.batch_threshold <= 1.0):
         raise ValueError("batch_threshold must be between 0.0 and 1.0")
+    if params.backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown backend: {params.backend!r}")
+    if params.backend == "pallas":
+        if np.dtype(params.dtype) != np.float32:
+            raise ValueError("backend='pallas' requires dtype='float32'")
+        if params.mesh is not None:
+            raise ValueError("backend='pallas' does not support mesh sharding")

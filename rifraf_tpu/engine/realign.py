@@ -46,7 +46,7 @@ class BatchAligner:
     """
 
     def __init__(self, reads: Sequence[ReadScores], dtype=np.float64,
-                 len_bucket: int = 64, mesh=None):
+                 len_bucket: int = 64, mesh=None, backend: str = "auto"):
         """`mesh`: an optional jax.sharding.Mesh with a "reads" axis. When
         given, the read axis of every batch array is sharded across the
         mesh, per-read DP fills run on their home devices, and the
@@ -57,6 +57,7 @@ class BatchAligner:
         self.dtype = np.dtype(dtype)
         self.len_bucket = int(len_bucket)
         self.mesh = mesh
+        self.backend = backend
         self.n_forward_fills = 0  # diagnostic: counts device forward launches
         self.set_batch(list(reads))
         self.A_bands = None
@@ -77,6 +78,7 @@ class BatchAligner:
         fixed = np.array([r.bandwidth_fixed for r in reads], dtype=bool)
         self.weights = None
         self._weights_dev = None
+        self._bw_dev = None  # sharded bandwidth cache (mesh path)
         self._lengths_host = np.asarray(batch.lengths)
         if self.mesh is not None:
             from ..parallel.sharding import pad_batch_to, shard_batch, shard_read_axis
@@ -114,12 +116,33 @@ class BatchAligner:
         batch = self.batch._replace(bandwidth=self.bandwidths)
         return _bucket(align_jax.band_height(batch, tlen), 8)
 
+    def _use_pallas(self) -> bool:
+        """Pallas handles score-only fills in float32 on a single device;
+        the mesh path and the moves variant stay on XLA.
+
+        "auto" resolves to XLA: measured on TPU v5e (2026-07, see
+        BASELINE.md), the sequential-grid Pallas kernel is overhead-bound
+        (~700 ms vs ~5 ms for the XLA scan at 1 kb x 256 reads x K=56) and
+        its execution additionally degraded subsequent XLA launches in the
+        same process. The kernel remains available explicitly
+        (backend="pallas") and is oracle-verified in interpret mode."""
+        if self.mesh is not None or self.dtype != np.float32:
+            return False
+        return self.backend == "pallas"
+
+    def _pallas_interpret(self) -> bool:
+        import jax
+
+        return jax.default_backend() != "tpu"
+
     def _current_batch(self) -> ReadBatch:
         bw = self.bandwidths
         if self.mesh is not None:
-            from ..parallel.sharding import shard_read_axis
+            if self._bw_dev is None:
+                from ..parallel.sharding import shard_read_axis
 
-            bw = shard_read_axis(bw, self.mesh)
+                self._bw_dev = shard_read_axis(bw, self.mesh)
+            bw = self._bw_dev
         return self.batch._replace(bandwidth=bw)
 
     # --- alignment --------------------------------------------------------
@@ -147,6 +170,17 @@ class BatchAligner:
                 batch = self._current_batch()
                 K = self._K(tlen)
                 self.n_forward_fills += 1
+                if not want_moves and self._use_pallas():
+                    from ..ops.align_pallas import forward_batch_pallas
+
+                    bands, scores, geom = forward_batch_pallas(
+                        t, batch, tlen=tlen, K=K,
+                        interpret=self._pallas_interpret(),
+                    )
+                    self.A_bands, self.moves, self.geom = bands, None, geom
+                    self.scores = np.asarray(scores)
+                    self.tracebacks = None
+                    break
                 bands, moves, scores, geom = align_jax.forward_batch(
                     t, batch, tlen=tlen, K=K, want_moves=want_moves
                 )
@@ -168,7 +202,15 @@ class BatchAligner:
         if realign_Bs:
             batch = self._current_batch()
             K = self._K(tlen)
-            B_bands, _, geom = align_jax.backward_batch(t, batch, tlen=tlen, K=K)
+            if self._use_pallas():
+                from ..ops.align_pallas import backward_batch_pallas
+
+                B_bands, _, geom = backward_batch_pallas(
+                    t, batch, tlen=tlen, K=K,
+                    interpret=self._pallas_interpret(),
+                )
+            else:
+                B_bands, _, geom = align_jax.backward_batch(t, batch, tlen=tlen, K=K)
             self.B_bands = B_bands
             self.geom = geom
 
@@ -190,6 +232,7 @@ class BatchAligner:
             ):
                 self.bandwidths[k] = min(int(self.bandwidths[k]) * 2, max_bw)
                 self._old_errors[k] = n_errors[k]
+                self._bw_dev = None  # invalidate the sharded device copy
                 grew = True
             else:
                 self.fixed[k] = True
@@ -201,8 +244,9 @@ class BatchAligner:
             weights = self.weights  # masks sharding-padding reads, if any
         if weights is None:
             return float(np.sum(self.scores))
-        # mask on weight, not value: 0 * -inf must not poison the total
-        return float(np.sum(np.where(weights > 0, weights * self.scores, 0.0)))
+        # mask BEFORE multiplying: 0 * -inf would be nan (and warn)
+        w = np.asarray(weights)
+        return float(np.sum(np.where(w > 0, self.scores, 0.0) * w))
 
     # --- proposal scoring -------------------------------------------------
     # cap on reads x proposals per launch: keeps the [N, K, P] scoring

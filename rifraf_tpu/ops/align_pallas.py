@@ -53,9 +53,15 @@ def _cumop(x, op, K):
     return x
 
 
-# Columns computed per grid step. Measured on TPU v5e: the per-column body
-# (two log-K sublane-roll scans) dominates, so batching columns does not
-# amortize anything — 1 is fastest.
+# Columns computed per grid step.
+#
+# Measured on TPU v5e (2026-07, BASELINE.md): this kernel is overhead-bound
+# at ~700 ms per fill for 1 kb x 256 reads x K=56 — the T+1 sequentially
+# iterated grid steps each do only ~2 log-K sublane-roll scans of a
+# [K, 128] tile, vs ~5 ms for the XLA lax.scan path whose per-column op
+# covers all reads at once. The kernel is therefore an explicit opt-in
+# (params.backend="pallas"), kept oracle-verified for TPU runtimes where
+# an on-core column sweep wins; the XLA path is the production default.
 COLS_PER_STEP = 1
 
 
@@ -140,10 +146,13 @@ def _prep_tables(batch: ReadBatch, geom: BandGeometry, K: int, NB: int,
                  Lbuf: int):
     """Host-side table shifting: read k's entry for DP row index r lands at
     buffer row `base_k + r` with base_k chosen so the column-j window is
-    rows [j + K, j + 2K) for every read."""
+    rows [j + K, j + 2K) for every read. Fully vectorized scatter (a Python
+    loop over 2048 reads would dominate the fill time)."""
     N = batch.n_reads
     n_pad = NB * LANES
-    off = np.asarray(geom.offset)
+    off = np.asarray(geom.offset).astype(np.int64)
+    lengths = np.asarray(batch.lengths).astype(np.int64)
+    L = batch.max_len
 
     match = np.zeros((Lbuf, n_pad), np.float32)
     mismatch = np.zeros((Lbuf, n_pad), np.float32)
@@ -151,17 +160,21 @@ def _prep_tables(batch: ReadBatch, geom: BandGeometry, K: int, NB: int,
     dels = np.zeros((Lbuf, n_pad), np.float32)
     seq = np.full((Lbuf, n_pad), -9, np.int32)
 
-    for k in range(N):
-        n = int(batch.lengths[k])
-        # match/mismatch/ins/seq indexed by i-1 -> base = K + off + 1
-        b = K + int(off[k]) + 1
-        match[b : b + n, k] = batch.match[k, :n]
-        mismatch[b : b + n, k] = batch.mismatch[k, :n]
-        ins[b : b + n, k] = batch.ins[k, :n]
-        seq[b : b + n, k] = batch.seq[k, :n]
-        # dels indexed by i -> base = K + off
-        b2 = K + int(off[k])
-        dels[b2 : b2 + n + 1, k] = batch.dels[k, : n + 1]
+    pos = np.arange(L)[None, :]  # [1, L]
+    live = pos < lengths[:, None]  # [N, L]
+    # match/mismatch/ins/seq indexed by i-1 -> base = K + off + 1
+    rows = (K + off[:, None] + 1 + pos)[live]
+    cols = np.broadcast_to(np.arange(N)[:, None], (N, L))[live]
+    match[rows, cols] = np.asarray(batch.match)[live]
+    mismatch[rows, cols] = np.asarray(batch.mismatch)[live]
+    ins[rows, cols] = np.asarray(batch.ins)[live]
+    seq[rows, cols] = np.asarray(batch.seq)[live]
+    # dels indexed by i -> base = K + off
+    pos1 = np.arange(L + 1)[None, :]
+    live1 = pos1 <= lengths[:, None]
+    rows1 = (K + off[:, None] + pos1)[live1]
+    cols1 = np.broadcast_to(np.arange(N)[:, None], (N, L + 1))[live1]
+    dels[rows1, cols1] = np.asarray(batch.dels)[live1]
 
     meta = np.zeros((4, 1, n_pad), np.int32)
     meta[0, 0, :N] = off
@@ -288,3 +301,76 @@ def forward_batch_pallas(
     band = band_flat[: T1 * K].reshape(T1, K, NB * LANES)[:, :, : batch.n_reads]
     band = jnp.transpose(band, (2, 1, 0))
     return band, scores[0, : batch.n_reads], geom
+
+
+def _reverse_batch_host(batch: ReadBatch) -> ReadBatch:
+    """Reverse each read's true-length prefix (host-side twin of
+    align_jax._reverse_read; padding tails stay in place)."""
+    lengths = np.asarray(batch.lengths).astype(np.int64)
+    N, L = batch.seq.shape
+
+    k = np.arange(L)[None, :]
+    idx = np.where(k < lengths[:, None], lengths[:, None] - 1 - k, k)
+
+    def rev(a):
+        return np.take_along_axis(np.asarray(a), idx, axis=1)
+
+    k1 = np.arange(L + 1)[None, :]
+    idx1 = np.where(k1 <= lengths[:, None], lengths[:, None] - k1, k1)
+    dels_r = np.take_along_axis(np.asarray(batch.dels), idx1, axis=1)
+    return batch._replace(
+        seq=rev(batch.seq),
+        match=rev(batch.match),
+        mismatch=rev(batch.mismatch),
+        ins=rev(batch.ins),
+        dels=dels_r,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("K",))
+def _flip_bands(band, geom: BandGeometry, K: int):
+    """Flip reversed-sequence forward bands into backward bands
+    (align.jl:196-202 / align_jax._backward_one's flip + re-mask)."""
+
+    def flip_one(b, slen, tlen, bandwidth, offset, nd):
+        T1 = b.shape[1]
+        f = b[::-1, ::-1]
+        f = jnp.roll(f, nd - K, axis=0)
+        f = jnp.roll(f, tlen + 1 - T1, axis=1)
+        j = jnp.arange(T1, dtype=jnp.int32)
+        dd = jnp.arange(K, dtype=jnp.int32)
+        i = dd[:, None] + j[None, :] - offset
+        valid = (i >= 0) & (i <= slen) & (dd[:, None] < nd) & (j[None, :] <= tlen)
+        return jnp.where(valid, f, NEG_INF)
+
+    return jax.vmap(flip_one)(
+        band, geom.slen, geom.tlen, geom.bandwidth, geom.offset, geom.nd
+    )
+
+
+def backward_batch_pallas(
+    template: np.ndarray,
+    batch: ReadBatch,
+    tlen: Optional[int] = None,
+    K: Optional[int] = None,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, BandGeometry]:
+    """Pallas banded backward fill: forward kernel on host-reversed
+    sequences, then a jitted flip back into the original band frame.
+    Matches align_jax.backward_batch's band layout (with the kernel's
+    finite NEG_INF sentinel for out-of-band cells)."""
+    from .align_jax import band_height
+
+    if tlen is None:
+        tlen = len(template)
+    if K is None:
+        K = band_height(batch, tlen)
+    K = max(((K + 7) // 8) * 8, 8)
+    rbatch = _reverse_batch_host(batch)
+    rt = np.asarray(template).copy()
+    rt[:tlen] = rt[:tlen][::-1]
+    band, scores, _ = forward_batch_pallas(
+        rt, rbatch, tlen=tlen, K=K, interpret=interpret
+    )
+    geom = batch_geometry(batch, tlen)
+    return _flip_bands(band, geom, K), scores, geom

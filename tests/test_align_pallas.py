@@ -34,3 +34,55 @@ def test_pallas_forward_matches_xla():
     np.testing.assert_allclose(bp[finite], bx[finite], rtol=1e-4, atol=1e-4)
     # out-of-band cells are "minus infinity" in both representations
     assert (bp[~np.isfinite(bx)] < -1e30).all()
+
+
+def test_pallas_backward_matches_xla():
+    rng = np.random.default_rng(1)
+    tlen = 29
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for slen in (26, 29, 34, 22):
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -1.0, size=slen)
+        reads.append(make_read_scores(s, log_p, 6, SCORES))
+    batch = batch_reads(reads, dtype=np.float32)
+
+    from rifraf_tpu.ops.align_pallas import backward_batch_pallas
+
+    bandsP, scoresP, _ = backward_batch_pallas(template, batch, interpret=True)
+    K = bandsP.shape[1]
+    bandsX, scoresX, _ = align_jax.backward_batch(template, batch, K=K)
+
+    np.testing.assert_allclose(
+        np.asarray(scoresP), np.asarray(scoresX), rtol=1e-4, atol=1e-4
+    )
+    bp = np.asarray(bandsP)
+    bx = np.asarray(bandsX)
+    finite = np.isfinite(bx) & (bp > -1e30)
+    np.testing.assert_allclose(bp[finite], bx[finite], rtol=1e-4, atol=1e-4)
+    assert (bp[~np.isfinite(bx)] < -1e30).all()
+
+
+def test_rifraf_backend_pallas_matches_xla():
+    """Full driver with backend="pallas" (interpret mode on CPU): the
+    Pallas fills must produce the identical consensus and a matching
+    score to the XLA backend at float32."""
+    from rifraf_tpu.engine.driver import rifraf
+    from rifraf_tpu.engine.params import RifrafParams
+    from rifraf_tpu.models.errormodel import ErrorModel
+    from rifraf_tpu.sim.sample import sample_sequences
+
+    rng = np.random.default_rng(17)
+    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=5, length=40, error_rate=0.02, rng=rng,
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    # len_bucket small keeps interpret-mode shapes tiny
+    base = rifraf(seqs, phreds=phreds,
+                  params=RifrafParams(dtype="float32", backend="xla",
+                                      len_bucket=16))
+    pal = rifraf(seqs, phreds=phreds,
+                 params=RifrafParams(dtype="float32", backend="pallas",
+                                     len_bucket=16))
+    assert np.array_equal(base.consensus, pal.consensus)
+    assert np.isclose(base.state.score, pal.state.score, rtol=1e-4)

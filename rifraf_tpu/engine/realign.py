@@ -99,6 +99,13 @@ class BatchAligner:
             self._weights_dev = shard_read_axis(
                 self.weights.astype(self.dtype), self.mesh
             )
+        else:
+            # device-resident once per batch selection: per-call
+            # host->device transfers dominate the unfused step otherwise
+            # (BASELINE.md round-2 measurements)
+            import jax.numpy as jnp
+
+            batch = ReadBatch(*[jnp.asarray(a) for a in batch])
         self.batch = batch
         self.bandwidths = bandwidths
         self.fixed = fixed
@@ -159,6 +166,7 @@ class BatchAligner:
         model.jl:643-672)."""
         t = self._padded_template(consensus)
         tlen = len(consensus)
+        self._tlen = tlen
         if realign_As:
             self._old_errors = np.full(len(self.reads), np.iinfo(np.int64).max)
             # cap is computed ONCE from the bandwidths at entry
@@ -260,8 +268,16 @@ class BatchAligner:
 
         Sharded path: the [N, P] per-read scores stay on device and reduce
         over the sharded read axis (XLA psum over ICI) — only the [P]
-        totals come back to the host."""
+        totals come back to the host.
+
+        Dense path: when the candidate set covers a large fraction of all
+        possible edits (the INIT/FRAME/SCORE stages score ~9*len of them,
+        model.jl:401-456), the per-proposal column gathers are replaced by
+        one dense sweep scoring EVERY edit (ops.proposal_dense) and the
+        requested entries are read out of the tables."""
         n = self.batch.n_reads
+        if len(proposals) >= 2 * getattr(self, "_tlen", 1 << 30):
+            return self._score_proposals_dense(proposals)
         chunk = max(128, self.MAX_SCORE_ELEMS // max(n, 1))
         batch = self._current_batch()
         outs = []
@@ -280,6 +296,28 @@ class BatchAligner:
         if not outs:
             return np.empty(0, dtype=self.dtype)
         return np.concatenate(outs) if len(outs) > 1 else outs[0]
+
+    def _score_proposals_dense(self, proposals: Sequence[Proposal]) -> np.ndarray:
+        from ..ops.proposal_dense import score_all_edits
+        from .proposals import Deletion, Insertion, Substitution
+
+        weights = None
+        if self._weights_dev is not None:
+            weights = self._weights_dev
+        sub_t, ins_t, del_t = score_all_edits(
+            self.A_bands, self.B_bands, self._current_batch(), self.geom,
+            weights=weights,
+        )
+        sub_t, ins_t, del_t = map(np.asarray, (sub_t, ins_t, del_t))
+        out = np.empty(len(proposals), dtype=sub_t.dtype)
+        for k, p in enumerate(proposals):
+            if isinstance(p, Substitution):
+                out[k] = sub_t[p.pos, p.base]
+            elif isinstance(p, Insertion):
+                out[k] = ins_t[p.pos, p.base]
+            else:
+                out[k] = del_t[p.pos]
+        return out
 
     def export_bandwidths(self) -> None:
         """Write adapted bandwidths back into the ReadScores objects so

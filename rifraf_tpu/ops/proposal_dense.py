@@ -1,0 +1,155 @@
+"""Dense all-edits proposal scoring: one launch for every possible edit.
+
+TPU-native second-generation scorer for the O(bandwidth) rescoring trick
+(/root/reference/src/model.jl:242-285 + util.jl:40-48). The first-generation
+kernel (proposal_jax) vectorizes over an arbitrary proposal LIST, gathering
+the A/B band columns each proposal touches — fine for sparse candidate
+sets, but the hill-climbing stages score ~9*len edits (every substitution
+and insertion at every position, every deletion: model.jl:401-456), and at
+that density per-proposal gathers re-read the bands hundreds of times.
+
+This module scores ALL single-base edits at once with band-shaped tensor
+ops, no proposal axis at all:
+
+- deletions: ``max_d(A[d, j] + B[d-1, j+1])`` for every j simultaneously —
+  one shifted add over the band and a max along the band axis;
+- substitutions/insertions: the "one new column" recomputation
+  (model.jl:242-285) for every position as a single [K, T+1] sweep — the
+  skewed score-table gathers (one per table, reused by all 4 bases), the
+  candidate max, and the within-column insert chain as a batched
+  ``cummax`` along the band axis, joined with the B band;
+- the read axis is vmapped, and the weighted read-reduction happens on
+  device, so a sharded batch psums partial sums over ICI.
+
+Cost: ~30 band-sized tensor ops per read for all 9*len+4 edits, vs
+O(len) per-proposal column gathers — the arithmetic intensity that the
+VPU wants. Returns score TABLES (sub [T+1, 4], ins [T+1, 4], del [T+1])
+matching estimate_probs' layout (model.jl:737-791); entries at positions
+beyond the true template length are meaningless and must be sliced off by
+the caller.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.sequences import ReadBatch
+from .align_jax import BandGeometry
+
+NEG_INF = -jnp.inf
+
+
+def _dense_one_read(
+    A,  # [K, T1] cached forward band
+    B,  # [K, T1] cached backward band
+    seq,  # int8 [L]
+    match,  # [L]
+    mismatch,  # [L]
+    ins,  # [L]
+    dels,  # [L + 1]
+    geom: BandGeometry,  # per-read scalars
+):
+    """All-edit score tables for one read (vmapped over the batch).
+
+    Mirrors proposal_jax._score_one_read cell-for-cell, with the proposal
+    axis replaced by the template-position axis of the bands themselves.
+    """
+    K, T1 = A.shape
+    L = seq.shape[0]
+    dtype = A.dtype
+    slen, tlen, off = geom.slen, geom.tlen, geom.offset
+    v_off = jnp.maximum(slen - tlen, 0)
+
+    d = jnp.arange(K, dtype=jnp.int32)[:, None]  # [K, 1]
+    j = jnp.arange(T1, dtype=jnp.int32)[None, :]  # [1, T1] = proposal pos
+
+    # row-range bounds of the recomputed column (model.jl:263)
+    jc = jnp.minimum(j + 1, tlen)
+    rmin = jnp.maximum(0, jc - off)
+    rmax = jnp.minimum(jc + v_off + geom.bandwidth, slen)
+
+    # B[:, pos+1] for every pos at once
+    jnext = jnp.minimum(jnp.arange(T1, dtype=jnp.int32) + 1, tlen)
+    B_next = jnp.take(B, jnext, axis=1)  # [K, T1]
+    neg_row = jnp.full((1, T1), NEG_INF, dtype)
+    A_up = jnp.concatenate([A[1:], neg_row], axis=0)  # A[d+1, j]
+    A_dn = jnp.concatenate([neg_row, A[:-1]], axis=0)  # A[d-1, j]
+
+    # --- deletions: join A[:, pos] with B[:, pos+1] one data row down ---
+    B_next_sh = jnp.concatenate([neg_row, B_next[:-1]], axis=0)
+    dele = jnp.max(A + B_next_sh, axis=0)  # [T1]; valid for pos < tlen
+
+    def edit_scores(i, m_src, d_src, B_join):
+        """Sub/ins share this: new column from (m_src, d_src) at true row
+        index i[d, j], joined with B_join — for all positions and all 4
+        bases. The score-table gathers are per-table, shared by bases."""
+        si = jnp.clip(i - 1, 0, L - 1)
+        sq = seq[si]
+        mt = match[si]
+        mm = mismatch[si]
+        gi = ins[si]
+        dl = dels[jnp.clip(i, 0, L)]
+        valid = (i >= rmin) & (i <= rmax)
+        dcand = d_src + dl
+        g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
+        G = jnp.cumsum(g, axis=0)
+        outs = []
+        for b in range(4):
+            msc = jnp.where(sq == b, mt, mm)
+            mcand = jnp.where(i >= 1, m_src + msc, NEG_INF)
+            cand = jnp.where(valid, jnp.maximum(mcand, dcand), NEG_INF)
+            NC = G + jax.lax.cummax(cand - G, axis=0)
+            NC = jnp.where(valid, NC, NEG_INF)
+            outs.append(jnp.max(NC + B_join, axis=0))
+        return jnp.stack(outs, axis=-1)  # [T1, 4]
+
+    # substitution at pos: new column in frame pos+1, joined with B[:, pos+1]
+    subs = edit_scores(d + j + 1 - off, A, A_up, B_next)
+    # insertion after pos: new column in frame pos, joined with B[:, pos]
+    insr = edit_scores(d + j - off, A_dn, A, B)
+    return subs, insr, dele
+
+
+_dense_batch = jax.vmap(_dense_one_read, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
+
+
+@jax.jit
+def _dense_total(A, B, seq, match, mismatch, ins, dels, geom, weights):
+    subs, insr, dele = _dense_batch(A, B, seq, match, mismatch, ins, dels, geom)
+
+    def wsum(x):
+        w = weights.reshape((-1,) + (1,) * (x.ndim - 1))
+        # mask BEFORE multiplying: 0 * -inf must not poison the total
+        return jnp.sum(jnp.where(w > 0, x, 0.0) * w, axis=0)
+
+    return wsum(subs), wsum(insr), wsum(dele)
+
+
+def score_all_edits(
+    A_bands,
+    B_bands,
+    batch: ReadBatch,
+    geom: BandGeometry,
+    weights=None,
+):
+    """Batch-total score tables for every single-base edit.
+
+    Returns (sub [T1, 4], ins [T1, 4], del [T1]) — already summed over
+    reads on device (psum over a sharded read axis). Positions >= the true
+    template length are garbage; slice before use.
+    """
+    if weights is None:
+        weights = jnp.ones(batch.n_reads, dtype=A_bands.dtype)
+    return _dense_total(
+        A_bands,
+        B_bands,
+        jnp.asarray(batch.seq),
+        jnp.asarray(batch.match),
+        jnp.asarray(batch.mismatch),
+        jnp.asarray(batch.ins),
+        jnp.asarray(batch.dels),
+        geom,
+        jnp.asarray(weights),
+    )

@@ -1,0 +1,127 @@
+"""Dense all-edits scorer vs the per-proposal JAX scorer and the numpy
+oracle: identical scores for every edit at every position."""
+
+import numpy as np
+
+from rifraf_tpu.engine.proposals import Deletion, Insertion, Substitution
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax
+from rifraf_tpu.ops.proposal_dense import score_all_edits
+from rifraf_tpu.ops.proposal_jax import score_proposals_batch
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0))
+
+
+def _problem(n_reads=6, tlen=31, seed=7):
+    rng = np.random.default_rng(seed)
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n_reads):
+        slen = int(rng.integers(tlen - 6, tlen + 7))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -0.5, size=slen)
+        reads.append(make_read_scores(s, log_p, 6, SCORES))
+    return template, batch_reads(reads, dtype=np.float64)
+
+
+def _all_edits(tlen):
+    return (
+        [Substitution(p, b) for p in range(tlen) for b in range(4)]
+        + [Insertion(p, b) for p in range(tlen + 1) for b in range(4)]
+        + [Deletion(p) for p in range(tlen)]
+    )
+
+
+def test_dense_matches_per_proposal_scorer():
+    template, batch = _problem()
+    tlen = len(template)
+    K = align_jax.band_height(batch, tlen)
+    A, _, _, geom = align_jax.forward_batch(template, batch, tlen=tlen, K=K)
+    B, _, _ = align_jax.backward_batch(template, batch, tlen=tlen, K=K)
+
+    sub_t, ins_t, del_t = score_all_edits(A, B, batch, geom)
+    sub_t, ins_t, del_t = map(np.asarray, (sub_t, ins_t, del_t))
+
+    proposals = _all_edits(tlen)
+    want = np.asarray(
+        score_proposals_batch(A, B, batch, geom, proposals)
+    ).sum(axis=0)
+
+    got = np.empty(len(proposals))
+    for k, p in enumerate(proposals):
+        if isinstance(p, Substitution):
+            got[k] = sub_t[p.pos, p.base]
+        elif isinstance(p, Insertion):
+            got[k] = ins_t[p.pos, p.base]
+        else:
+            got[k] = del_t[p.pos]
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+
+def test_dense_matches_full_realignment_oracle():
+    """The exactness property (test_model.jl:39-153): a dense-table entry
+    equals the full realignment score of the edited template."""
+    from rifraf_tpu.engine.proposals import apply_proposals
+
+    template, batch = _problem(n_reads=3, tlen=19, seed=11)
+    tlen = len(template)
+    K = align_jax.band_height(batch, tlen)
+    A, _, _, geom = align_jax.forward_batch(template, batch, tlen=tlen, K=K)
+    B, _, _ = align_jax.backward_batch(template, batch, tlen=tlen, K=K)
+    sub_t, ins_t, del_t = map(
+        np.asarray, score_all_edits(A, B, batch, geom)
+    )
+
+    rng = np.random.default_rng(0)
+    cases = [Substitution(int(rng.integers(tlen)), int(rng.integers(4)))
+             for _ in range(8)]
+    cases += [Insertion(int(rng.integers(tlen + 1)), int(rng.integers(4)))
+              for _ in range(8)]
+    cases += [Deletion(int(rng.integers(tlen))) for _ in range(8)]
+    cases += [Insertion(0, 2), Insertion(tlen, 1), Deletion(0),
+              Deletion(tlen - 1), Substitution(0, 3),
+              Substitution(tlen - 1, 0)]
+
+    for p in cases:
+        new_t = apply_proposals(template, [p])
+        _, _, scores, _ = align_jax.forward_batch(
+            new_t, batch, tlen=len(new_t), K=K + 2
+        )
+        want = float(np.sum(np.asarray(scores)))
+        if isinstance(p, Substitution):
+            got = sub_t[p.pos, p.base]
+        elif isinstance(p, Insertion):
+            got = ins_t[p.pos, p.base]
+        else:
+            got = del_t[p.pos]
+        np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10,
+                                   err_msg=str(p))
+
+
+def test_dense_weighted_masking():
+    """Weight-0 rows contribute nothing even when their tables hold -inf."""
+    template, batch = _problem(n_reads=4, tlen=23, seed=3)
+    tlen = len(template)
+    K = align_jax.band_height(batch, tlen)
+    A, _, _, geom = align_jax.forward_batch(template, batch, tlen=tlen, K=K)
+    B, _, _ = align_jax.backward_batch(template, batch, tlen=tlen, K=K)
+
+    w_all = np.ones(4)
+    w_masked = np.array([1.0, 1.0, 0.0, 0.0])
+    full = map(np.asarray, score_all_edits(A, B, batch, geom, weights=w_all))
+    part = map(np.asarray, score_all_edits(A, B, batch, geom, weights=w_masked))
+    per_read = np.asarray(
+        score_proposals_batch(A, B, batch, geom, _all_edits(tlen))
+    )
+    want_part = per_read[:2].sum(axis=0)
+    sub_p, ins_p, del_p = part
+    got = []
+    for k, p in enumerate(_all_edits(tlen)):
+        if isinstance(p, Substitution):
+            got.append(sub_p[p.pos, p.base])
+        elif isinstance(p, Insertion):
+            got.append(ins_p[p.pos, p.base])
+        else:
+            got.append(del_p[p.pos])
+    np.testing.assert_allclose(np.asarray(got), want_part, rtol=1e-12)

@@ -90,7 +90,9 @@ def main():
     if "--cpu" in sys.argv:
         import os
 
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # force-assign: an ambient JAX_PLATFORMS (e.g. a TPU plugin) would
+        # silently put the "CPU baseline" on the accelerator
+        os.environ["JAX_PLATFORMS"] = "cpu"
     dt = measure()
     # every substitution (4xT, incl. identity), insertion (4x(T+1)),
     # and deletion (T) is scored against every read in the step

@@ -95,6 +95,19 @@ class RifrafParams:
     backend: str = "auto"
 
 
+def validate_backend(backend: str, dtype, mesh) -> None:
+    """Shared backend/dtype/mesh compatibility rules, enforced both at the
+    driver boundary (check_params) and on direct BatchAligner construction
+    so an explicit backend request can never silently fall back."""
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown backend: {backend!r}")
+    if backend == "pallas":
+        if np.dtype(dtype) != np.float32:
+            raise ValueError("backend='pallas' requires dtype='float32'")
+        if mesh is not None:
+            raise ValueError("backend='pallas' does not support mesh sharding")
+
+
 def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> None:
     """model.jl:842-896."""
     for v in (scores.mismatch, scores.insertion, scores.deletion):
@@ -128,10 +141,4 @@ def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> No
         raise ValueError("batch_mult must be between 0.0 and 1.0")
     if not (0.0 <= params.batch_threshold <= 1.0):
         raise ValueError("batch_threshold must be between 0.0 and 1.0")
-    if params.backend not in ("auto", "xla", "pallas"):
-        raise ValueError(f"unknown backend: {params.backend!r}")
-    if params.backend == "pallas":
-        if np.dtype(params.dtype) != np.float32:
-            raise ValueError("backend='pallas' requires dtype='float32'")
-        if params.mesh is not None:
-            raise ValueError("backend='pallas' does not support mesh sharding")
+    validate_backend(params.backend, params.dtype, params.mesh)

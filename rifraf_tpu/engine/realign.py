@@ -26,6 +26,7 @@ from ..ops import align_jax, align_np
 from ..ops.banded_array import BandedArray
 from ..ops.proposal_jax import score_proposals_batch
 from ..utils.mathops import poisson_cquantile
+from .params import validate_backend
 from .proposals import Proposal
 from .scoring_np import score_proposal as score_proposal_np
 
@@ -58,6 +59,7 @@ class BatchAligner:
         self.len_bucket = int(len_bucket)
         self.mesh = mesh
         self.backend = backend
+        validate_backend(backend, self.dtype, mesh)
         self.n_forward_fills = 0  # diagnostic: counts device forward launches
         self.set_batch(list(reads))
         self.A_bands = None
@@ -132,9 +134,9 @@ class BatchAligner:
         (~700 ms vs ~5 ms for the XLA scan at 1 kb x 256 reads x K=56) and
         its execution additionally degraded subsequent XLA launches in the
         same process. The kernel remains available explicitly
-        (backend="pallas") and is oracle-verified in interpret mode."""
-        if self.mesh is not None or self.dtype != np.float32:
-            return False
+        (backend="pallas") and is oracle-verified in interpret mode.
+        validate_backend in __init__ guarantees pallas implies float32 and
+        no mesh."""
         return self.backend == "pallas"
 
     def _pallas_interpret(self) -> bool:

@@ -281,7 +281,9 @@ def forward_batch_pallas(
         tlen = len(template)
     if K is None:
         K = band_height(batch, tlen)
-    K = max(((K + 7) // 8) * 8, 8)  # f32 block sublane divisibility
+        K = max(((K + 7) // 8) * 8, 8)  # f32 block sublane divisibility
+    elif K <= 0 or K % 8:
+        raise ValueError(f"K must be a positive multiple of 8, got {K}")
     geom = batch_geometry(batch, tlen)
     NB = (batch.n_reads + LANES - 1) // LANES
     T1 = len(template) + 1
@@ -358,14 +360,19 @@ def backward_batch_pallas(
     """Pallas banded backward fill: forward kernel on host-reversed
     sequences, then a jitted flip back into the original band frame.
     Matches align_jax.backward_batch's band layout (with the kernel's
-    finite NEG_INF sentinel for out-of-band cells)."""
+    finite NEG_INF sentinel for out-of-band cells). A caller-supplied K
+    must be a positive multiple of 8 (the kernel's sublane tile): silently
+    rounding here would desynchronize the band height from an
+    align_jax.backward_batch call made with the same K."""
     from .align_jax import band_height
 
     if tlen is None:
         tlen = len(template)
     if K is None:
         K = band_height(batch, tlen)
-    K = max(((K + 7) // 8) * 8, 8)
+        K = max(((K + 7) // 8) * 8, 8)
+    elif K <= 0 or K % 8:
+        raise ValueError(f"K must be a positive multiple of 8, got {K}")
     rbatch = _reverse_batch_host(batch)
     rt = np.asarray(template).copy()
     rt[:tlen] = rt[:tlen][::-1]

@@ -24,8 +24,8 @@ from ..utils.mathops import logsumexp10
 from ..utils.phred import phred_to_log_p, phred_to_p
 from .generate import (
     all_proposals,
-    alignment_proposals,
     has_single_indels,
+    proposals_from_edits,
     single_indel_proposals,
 )
 from .params import RifrafParams, Stage, check_params, next_stage
@@ -272,23 +272,22 @@ def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
         state.realign_As = True
         state.realign_Bs = True
     _log(params, 2, f"    realigning As={state.realign_As} Bs={state.realign_Bs}")
-    # tracebacks (the moves band) are only needed for alignment-derived
-    # proposals, quality estimation, and bandwidth adaptation — skip the
-    # device->host move transfer otherwise (e.g. FRAME iterations)
-    want_moves = (
-        (
-            state.stage in (Stage.INIT, Stage.REFINE)
-            and params.do_alignment_proposals
-        )
-        or state.stage == Stage.SCORE
-        or not state.aligner.fixed.all()
+    # alignment-derived proposals and bandwidth adaptation run on
+    # device-side traceback statistics (want_stats); real host tracebacks
+    # (want_moves: the expensive move-band fetch) are only needed for the
+    # SCORE stage's alignment pileup
+    want_stats = (
+        state.stage in (Stage.INIT, Stage.REFINE)
+        and params.do_alignment_proposals
     )
+    want_moves = state.stage == Stage.SCORE
     state.aligner.realign(
         state.consensus,
         params.bandwidth_pvalue,
         realign_As=state.realign_As,
         realign_Bs=state.realign_Bs,
         want_moves=want_moves,
+        want_stats=want_stats,
     )
     uref = use_ref(state, params.use_ref_for_qvs)
     if uref:
@@ -355,11 +354,8 @@ def get_candidates(
 
     if state.stage in (Stage.INIT, Stage.REFINE) and params.do_alignment_proposals:
         do_indels = state.stage == Stage.INIT
-        proposals = alignment_proposals(
-            state.aligner.tracebacks,
-            state.consensus,
-            [r.seq for r in state.batch_seqs],
-            do_indels,
+        proposals = proposals_from_edits(
+            state.aligner.edits_seen, len(state.consensus), do_indels
         )
     else:
         proposals = all_proposals(

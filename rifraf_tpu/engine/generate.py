@@ -106,6 +106,28 @@ def alignment_proposals(
     return list(result)
 
 
+def proposals_from_edits(
+    edits: np.ndarray, tlen: int, do_indels: bool
+) -> List[Proposal]:
+    """alignment_proposals (model.jl:483-497) from the device-computed
+    union edit-indicator table (ops.align_jax._traceback_stats_one):
+    rows = template positions, columns 0-3 substitution bases, 4-7
+    insertion bases, 8 deletion. Yields the same SET as the host traceback
+    walk — the reference materializes it via a Set, so order was never
+    part of the contract — without ever fetching the move bands."""
+    results: List[Proposal] = []
+    sub_pos, sub_base = np.nonzero(edits[:tlen, 0:4])
+    for p, b in zip(sub_pos, sub_base):
+        results.append(Substitution(int(p), int(b)))
+    if do_indels:
+        ins_pos, ins_base = np.nonzero(edits[: tlen + 1, 4:8])
+        for p, b in zip(ins_pos, ins_base):
+            results.append(Insertion(int(p), int(b)))
+        for p in np.nonzero(edits[:tlen, 8])[0]:
+            results.append(Deletion(int(p)))
+    return results
+
+
 def has_single_indels(consensus: np.ndarray, reference: ReadScores) -> bool:
     """model.jl:532-536."""
     moves = align_np.align_moves(consensus, reference)

@@ -74,9 +74,12 @@ class RifrafParams:
     verbose: int = 0
 
     # --- TPU-native additions (no reference equivalent) ---
-    # float dtype for device kernels; float64 matches the reference
-    # bit-for-bit on CPU, float32 is the TPU-native choice
-    dtype: str = "float64"
+    # float dtype for device kernels. None resolves per backend at run
+    # time (resolve_dtype): float64 when jax x64 is enabled (the CPU /
+    # exactness configuration, matching the reference bit-for-bit),
+    # float32 otherwise (the TPU-native choice — TPUs have no f64, and an
+    # explicit "float64" there would silently truncate)
+    dtype: Optional[str] = None
     # random seed for batch resampling (the reference uses global RNG state)
     seed: Optional[int] = 42
     # pad template lengths up to multiples of this so consensus edits do not
@@ -87,25 +90,38 @@ class RifrafParams:
     # XLA-inserted psum over ICI for the score reductions (replaces the
     # reference's process-level pmap, scripts/rifraf.jl:190-191)
     mesh: Optional[object] = None
-    # alignment-fill engine: "auto" (= "xla"; the scan kernel measured
-    # fastest on available TPU hardware, see BASELINE.md), "xla", or
-    # "pallas" (on-core column sweep; float32, score-only fills,
-    # explicit opt-in). The moves-recording forward variant always
-    # uses XLA.
+    # alignment-fill engine: "auto" (= "xla": the fused scan-kernel step,
+    # the only driver path). "pallas" is rejected — the experimental
+    # on-core column sweep (ops.align_pallas) measured ~100x slower than
+    # the fused XLA step on the available TPU (BASELINE.md) and was
+    # retired from the driver.
     backend: str = "auto"
 
 
+def resolve_dtype(dtype) -> np.dtype:
+    """Resolve the device dtype: an explicit request wins; None picks
+    float64 under jax x64 (exactness/CPU) and float32 otherwise (TPU)."""
+    if dtype is not None:
+        return np.dtype(dtype)
+    import jax
+
+    return np.dtype(np.float64 if jax.config.jax_enable_x64 else np.float32)
+
+
 def validate_backend(backend: str, dtype, mesh) -> None:
-    """Shared backend/dtype/mesh compatibility rules, enforced both at the
-    driver boundary (check_params) and on direct BatchAligner construction
-    so an explicit backend request can never silently fall back."""
-    if backend not in ("auto", "xla", "pallas"):
-        raise ValueError(f"unknown backend: {backend!r}")
+    """Shared backend validation, enforced both at the driver boundary
+    (check_params) and on direct BatchAligner construction so an explicit
+    backend request can never silently fall back."""
     if backend == "pallas":
-        if np.dtype(dtype) != np.float32:
-            raise ValueError("backend='pallas' requires dtype='float32'")
-        if mesh is not None:
-            raise ValueError("backend='pallas' does not support mesh sharding")
+        raise ValueError(
+            "backend='pallas' was retired from the driver: the sequential-"
+            "grid Pallas fill measured ~100x slower than the fused XLA "
+            "step on the available TPU and degraded subsequent XLA "
+            "launches (BASELINE.md). The oracle-verified kernels remain "
+            "available directly in rifraf_tpu.ops.align_pallas."
+        )
+    if backend not in ("auto", "xla"):
+        raise ValueError(f"unknown backend: {backend!r}")
 
 
 def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> None:

@@ -1,9 +1,12 @@
 """Batched realignment engine: device-side A/B/move bands for a read batch.
 
 This replaces the reference's per-read host loops (model.jl:643-714) with
-three batched device launches per iteration (forward+moves, backward,
-proposal scoring), plus host logic for adaptive bandwidth
-(model.jl:643-672). All shapes are bucketed so the hill-climbing loop —
+ONE fused device dispatch per iteration (ops.fused.fused_step_full:
+forward fill + backward fill + dense all-edits score tables + weighted
+totals), plus host logic for adaptive bandwidth (model.jl:643-672).
+Proposal scoring reads out of the cached dense tables — no further device
+launches — and the per-read scores / total stay on device until a float
+is actually needed. All shapes are bucketed so the hill-climbing loop —
 whose consensus length, bandwidths, and batch size all change — re-uses
 cached XLA executables instead of recompiling:
 
@@ -26,7 +29,8 @@ from ..ops import align_jax, align_np
 from ..ops.banded_array import BandedArray
 from ..ops.proposal_jax import score_proposals_batch
 from ..utils.mathops import poisson_cquantile
-from .params import validate_backend
+from ..utils.timers import Timers
+from .params import resolve_dtype, validate_backend
 from .proposals import Proposal
 from .scoring_np import score_proposal as score_proposal_np
 
@@ -46,7 +50,7 @@ class BatchAligner:
     (the As/Bs/Amoves caches of RifrafState, model.jl:176-182).
     """
 
-    def __init__(self, reads: Sequence[ReadScores], dtype=np.float64,
+    def __init__(self, reads: Sequence[ReadScores], dtype=None,
                  len_bucket: int = 64, mesh=None, backend: str = "auto"):
         """`mesh`: an optional jax.sharding.Mesh with a "reads" axis. When
         given, the read axis of every batch array is sharded across the
@@ -55,19 +59,20 @@ class BatchAligner:
         inserts the psum over ICI. One consensus then spans all chips
         (the BASELINE north star; replaces scripts/rifraf.jl:190-191's
         process parallelism with collectives)."""
-        self.dtype = np.dtype(dtype)
+        self.dtype = resolve_dtype(dtype)
         self.len_bucket = int(len_bucket)
         self.mesh = mesh
         self.backend = backend
         validate_backend(backend, self.dtype, mesh)
         self.n_forward_fills = 0  # diagnostic: counts device forward launches
+        self.timers = Timers()
         self.set_batch(list(reads))
         self.A_bands = None
         self.B_bands = None
         self.moves = None
         self.geom = None
         self.tracebacks: Optional[List[List[int]]] = None
-        self.scores: Optional[np.ndarray] = None
+        self.scores = None  # [N] per-read totals, device-resident
 
     # --- batch management -------------------------------------------------
     def set_batch(self, reads: List[ReadScores]) -> None:
@@ -114,6 +119,9 @@ class BatchAligner:
         self.est_n_errors = np.array([r.est_n_errors for r in reads])
         self.A_bands = None
         self.B_bands = None
+        self._tables_host = None
+        self._total = None
+        self.edits_seen = None
 
     def _padded_template(self, consensus: np.ndarray) -> np.ndarray:
         T = _bucket(len(consensus) + 1, self.len_bucket)
@@ -124,25 +132,6 @@ class BatchAligner:
     def _K(self, tlen: int) -> int:
         batch = self.batch._replace(bandwidth=self.bandwidths)
         return _bucket(align_jax.band_height(batch, tlen), 8)
-
-    def _use_pallas(self) -> bool:
-        """Pallas handles score-only fills in float32 on a single device;
-        the mesh path and the moves variant stay on XLA.
-
-        "auto" resolves to XLA: measured on TPU v5e (2026-07, see
-        BASELINE.md), the sequential-grid Pallas kernel is overhead-bound
-        (~700 ms vs ~5 ms for the XLA scan at 1 kb x 256 reads x K=56) and
-        its execution additionally degraded subsequent XLA launches in the
-        same process. The kernel remains available explicitly
-        (backend="pallas") and is oracle-verified in interpret mode.
-        validate_backend in __init__ guarantees pallas implies float32 and
-        no mesh."""
-        return self.backend == "pallas"
-
-    def _pallas_interpret(self) -> bool:
-        import jax
-
-        return jax.default_backend() != "tpu"
 
     def _current_batch(self) -> ReadBatch:
         bw = self.bandwidths
@@ -161,68 +150,102 @@ class BatchAligner:
         pvalue: float,
         realign_As: bool = True,
         realign_Bs: bool = True,
-        want_moves: bool = True,
+        want_moves: bool = False,
+        want_stats: bool = False,
     ) -> None:
-        """Forward (+moves) and backward, with adaptive bandwidth on the
-        first alignment of each read (smart_forward_moves!,
-        model.jl:643-672)."""
+        """One fused device dispatch + ONE packed device->host fetch:
+        forward (+moves), backward, dense all-edit score tables, weighted
+        totals, and (want_stats) device-side traceback statistics — with
+        adaptive bandwidth on the first alignment of each read
+        (smart_forward_moves!, model.jl:643-672).
+
+        `want_stats` computes per-read alignment error counts and the
+        union edit-indicator table on device (alignment-derived proposals
+        + bandwidth adaptation). `want_moves` additionally ships the move
+        band to the host and walks real tracebacks (SCORE stage only —
+        the fetch is expensive, see ops.fused docstring).
+
+        `realign_As`/`realign_Bs` are accepted for driver API parity with
+        the reference's dirty flags (model.jl:689, 703) but the fused
+        program always refills both bands: on device a redundant refill is
+        ~100x cheaper than a second dispatch (BASELINE.md).
+        """
+        import jax.numpy as jnp
+
+        from ..ops.fused import fused_step_full, pack_layout
+
         t = self._padded_template(consensus)
         tlen = len(consensus)
         self._tlen = tlen
-        if realign_As:
-            self._old_errors = np.full(len(self.reads), np.iinfo(np.int64).max)
-            # cap is computed ONCE from the bandwidths at entry
-            # (model.jl:650: seq.bandwidth * 2^5); recomputing from the
-            # already-doubled value each round would let a read grow past
-            # the final refill, leaving A and B with mismatched band heights
-            entry_bw = self.bandwidths.copy()
-            for _round in range(MAX_BANDWIDTH_DOUBLINGS + 1):
-                batch = self._current_batch()
-                K = self._K(tlen)
-                self.n_forward_fills += 1
-                if not want_moves and self._use_pallas():
-                    from ..ops.align_pallas import forward_batch_pallas
-
-                    bands, scores, geom = forward_batch_pallas(
-                        t, batch, tlen=tlen, K=K,
-                        interpret=self._pallas_interpret(),
-                    )
-                    self.A_bands, self.moves, self.geom = bands, None, geom
-                    self.scores = np.asarray(scores)
-                    self.tracebacks = None
-                    break
-                bands, moves, scores, geom = align_jax.forward_batch(
-                    t, batch, tlen=tlen, K=K, want_moves=want_moves
-                )
-                self.A_bands, self.moves, self.geom = bands, moves, geom
-                self.scores = np.asarray(scores)
-                if not want_moves:
-                    self.tracebacks = None
-                    break
-                paths, n_errors = align_jax.traceback_batch(
-                    np.asarray(moves), geom, seqs=batch.seq, template=t
-                )
-                self.tracebacks = paths
-                if self.fixed.all():
-                    break
-                grew = self._maybe_grow_bandwidth(n_errors, tlen, pvalue, entry_bw)
-                if not grew:
-                    self.fixed[:] = True
-                    break
-        if realign_Bs:
+        T1 = len(t) + 1
+        weights = self._weights_dev
+        if weights is None:
+            weights = jnp.ones(self.batch.n_reads, dtype=self.dtype)
+        self._old_errors = np.full(len(self.reads), np.iinfo(np.int64).max)
+        # cap is computed ONCE from the bandwidths at entry
+        # (model.jl:650: seq.bandwidth * 2^5); recomputing from the
+        # already-doubled value each round would let a read grow past
+        # the final refill, leaving A and B with mismatched band heights
+        entry_bw = self.bandwidths.copy()
+        t_dev = jnp.asarray(t, jnp.int8)
+        for _round in range(MAX_BANDWIDTH_DOUBLINGS + 1):
             batch = self._current_batch()
             K = self._K(tlen)
-            if self._use_pallas():
-                from ..ops.align_pallas import backward_batch_pallas
-
-                B_bands, _, geom = backward_batch_pallas(
-                    t, batch, tlen=tlen, K=K,
-                    interpret=self._pallas_interpret(),
+            geom = align_jax.batch_geometry(batch, tlen)
+            adapting = not bool(self.fixed.all())
+            stats_now = want_stats or adapting
+            self.n_forward_fills += 1
+            with self.timers.time("fused_dispatch"):
+                A, B, moves, packed = fused_step_full(
+                    t_dev,
+                    batch.seq,
+                    batch.match,
+                    batch.mismatch,
+                    batch.ins,
+                    batch.dels,
+                    geom,
+                    weights,
+                    K,
+                    want_moves,
+                    stats_now,
                 )
+            self.A_bands, self.B_bands = A, B
+            self.moves, self.geom = moves, geom
+            with self.timers.time("packed_fetch"):
+                ph = np.asarray(packed)
+            lay = pack_layout(self.batch.n_reads, T1, stats_now)
+            self._total = float(ph[0])
+            self.scores = ph[slice(*lay["scores"])]
+            self._tables_host = (
+                ph[slice(*lay["sub"])].reshape(T1, 4),
+                ph[slice(*lay["ins"])].reshape(T1, 4),
+                ph[slice(*lay["del"])],
+            )
+            n_errors = None
+            if stats_now:
+                n_errors = ph[slice(*lay["n_errors"])].astype(np.int64)
+                if (n_errors[: len(self.reads)] < 0).any():
+                    raise RuntimeError(
+                        "device traceback hit TRACE_NONE (malformed band)"
+                    )
+                self.edits_seen = ph[slice(*lay["edits"])].reshape(T1, 9) > 0
             else:
-                B_bands, _, geom = align_jax.backward_batch(t, batch, tlen=tlen, K=K)
-            self.B_bands = B_bands
-            self.geom = geom
+                self.edits_seen = None
+            if want_moves:
+                with self.timers.time("moves_fetch"):
+                    moves_host = np.asarray(moves)
+                with self.timers.time("traceback_walk"):
+                    self.tracebacks = align_jax.traceback_batch(
+                        moves_host, geom
+                    )
+            else:
+                self.tracebacks = None
+            if not adapting:
+                break
+            grew = self._maybe_grow_bandwidth(n_errors, tlen, pvalue, entry_bw)
+            if not grew:
+                self.fixed[:] = True
+                break
 
     def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float,
                               entry_bw: np.ndarray) -> bool:
@@ -249,14 +272,20 @@ class BatchAligner:
         return grew
 
     def total_score(self, weights: Optional[np.ndarray] = None) -> float:
-        """Sum of per-read alignment scores (rescore!, model.jl:630-635)."""
+        """Sum of per-read alignment scores (rescore!, model.jl:630-635).
+        The default total was already reduced on device by the fused step
+        and arrived in the packed fetch (with sharding-padding reads
+        masked); only custom weights force a host-side reduction."""
+        if weights is None and self._total is not None:
+            return self._total
         if weights is None:
             weights = self.weights  # masks sharding-padding reads, if any
+        scores = np.asarray(self.scores)
         if weights is None:
-            return float(np.sum(self.scores))
+            return float(np.sum(scores))
         # mask BEFORE multiplying: 0 * -inf would be nan (and warn)
         w = np.asarray(weights)
-        return float(np.sum(np.where(w > 0, self.scores, 0.0) * w))
+        return float(np.sum(np.where(w > 0, scores, 0.0) * w))
 
     # --- proposal scoring -------------------------------------------------
     # cap on reads x proposals per launch: keeps the [N, K, P] scoring
@@ -264,22 +293,22 @@ class BatchAligner:
     MAX_SCORE_ELEMS = 2048 * 2048
 
     def score_proposals(self, proposals: Sequence[Proposal]) -> np.ndarray:
-        """Total score of each proposal across the batch, in as few device
-        launches as memory allows (the reference's per-proposal-per-read
-        host loop, model.jl:385-399).
+        """Total score of each proposal across the batch (the reference's
+        per-proposal-per-read host loop, model.jl:385-399).
 
-        Sharded path: the [N, P] per-read scores stay on device and reduce
-        over the sharded read axis (XLA psum over ICI) — only the [P]
-        totals come back to the host.
-
-        Dense path: when the candidate set covers a large fraction of all
-        possible edits (the INIT/FRAME/SCORE stages score ~9*len of them,
-        model.jl:401-456), the per-proposal column gathers are replaced by
-        one dense sweep scoring EVERY edit (ops.proposal_dense) and the
-        requested entries are read out of the tables."""
+        The fused realign already computed batch-total score tables for
+        EVERY single-base edit (ops.proposal_dense, reduced over the —
+        possibly sharded — read axis on device) and shipped them in the
+        packed fetch, so scoring any proposal set is a host-side table
+        readout: zero additional device work. The sparse per-proposal
+        kernel (ops.proposal_jax) remains the fallback when no tables are
+        cached."""
+        if len(proposals) == 0:
+            return np.empty(0, dtype=self.dtype)
+        if self._tables_host is not None:
+            with self.timers.time("tables_readout"):
+                return self._read_tables(self._tables_host, proposals)
         n = self.batch.n_reads
-        if len(proposals) >= 2 * getattr(self, "_tlen", 1 << 30):
-            return self._score_proposals_dense(proposals)
         chunk = max(128, self.MAX_SCORE_ELEMS // max(n, 1))
         batch = self._current_batch()
         outs = []
@@ -295,22 +324,13 @@ class BatchAligner:
                 outs.append(np.asarray(weighted_read_sum(self._weights_dev, per_read)))
             else:
                 outs.append(np.asarray(per_read).sum(axis=0))
-        if not outs:
-            return np.empty(0, dtype=self.dtype)
         return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
-    def _score_proposals_dense(self, proposals: Sequence[Proposal]) -> np.ndarray:
-        from ..ops.proposal_dense import score_all_edits
-        from .proposals import Deletion, Insertion, Substitution
+    @staticmethod
+    def _read_tables(tables, proposals: Sequence[Proposal]) -> np.ndarray:
+        from .proposals import Insertion, Substitution
 
-        weights = None
-        if self._weights_dev is not None:
-            weights = self._weights_dev
-        sub_t, ins_t, del_t = score_all_edits(
-            self.A_bands, self.B_bands, self._current_batch(), self.geom,
-            weights=weights,
-        )
-        sub_t, ins_t, del_t = map(np.asarray, (sub_t, ins_t, del_t))
+        sub_t, ins_t, del_t = tables
         out = np.empty(len(proposals), dtype=sub_t.dtype)
         for k, p in enumerate(proposals):
             if isinstance(p, Substitution):

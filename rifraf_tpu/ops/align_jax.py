@@ -316,6 +316,93 @@ def backward_batch(
     return bands, scores, geom
 
 
+def _resolve_insert_chain(seed, ichain):
+    """On-path membership closure within one column: a cell at data row d
+    whose move is INSERT extends the path to row d-1, so membership
+    propagates DOWNWARD in d from every seed through runs of insert moves:
+    P[d-1] |= P[d] & ichain[d]. Solved in closed form with the same
+    max-plus cumulative trick as the fill's insert chain (_fill_column),
+    on the flipped axis and with finite sentinels (bool semiring embedded
+    as 0 / -1e6; path lengths <= K keep everything far from overflow)."""
+    s = seed[::-1]
+    c = ichain[::-1]
+    g = jnp.where(
+        jnp.concatenate([jnp.zeros((1,), bool), c[:-1]]), 0.0, -1e6
+    ).astype(jnp.float32)
+    cand = jnp.where(s, 0.0, -1e12).astype(jnp.float32)
+    G = jnp.cumsum(g)
+    F = G + jax.lax.cummax(cand - G)
+    return (F > -1e5)[::-1]
+
+
+def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
+    """Device traceback statistics for one read: (a) the alignment error
+    count of the optimal path (count_errors, align.jl:240-250) and (b) an
+    indicator table of the single-base edits the path implies
+    (moves_to_proposals, model.jl:458-480): columns 0-3 substitution
+    bases, 4-7 insertion bases, 8 deletion; rows = template positions.
+
+    The move band assigns every cell exactly one predecessor, so the
+    traceback path equals the predecessor-closure of the end cell — which
+    a reverse scan over columns computes with dense [K] vector ops (seed
+    from the next column's match/delete moves, then the within-column
+    insert-chain closure), no sequential pointer chase. This keeps the
+    statistics on device: at the driver's scales fetching the [N, K, T+1]
+    move band to the host costs latency + bytes/bandwidth EVERY iteration
+    (BASELINE.md: the D2H link is the scarcest resource on the available
+    hardware), and a per-read while_loop walk measured ~100x slower than
+    this scan at 10 kb templates.
+    """
+    L = seq.shape[0]
+    T1 = moves.shape[1]
+    d = jnp.arange(K, dtype=jnp.int32)
+    off = geom.offset
+    d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
+
+    def step(P, jc):
+        Mj = moves[:, jc]
+        # inject the end-cell seed at the last true column; carried seeds
+        # for padded columns (jc > tlen) are all-False so they emit nothing
+        seed = P | ((jc == geom.tlen) & (d == d_end))
+        on = _resolve_insert_chain(seed, Mj == TRACE_INSERT)
+        i = d + jc - off
+        sb = seq[jnp.clip(i - 1, 0, L - 1)].astype(jnp.int32)
+        tb = t[jnp.clip(jc - 1, 0, t.shape[0] - 1)]
+        is_m = on & (Mj == TRACE_MATCH)
+        is_i = on & (Mj == TRACE_INSERT)
+        is_d = on & (Mj == TRACE_DELETE)
+        mism = is_m & (sb != tb)
+        nerr_c = jnp.sum((mism | is_i | is_d).astype(jnp.int32))
+        sub_any = jnp.stack([jnp.any(mism & (sb == b)) for b in range(4)])
+        ins_any = jnp.stack([jnp.any(is_i & (sb == b)) for b in range(4)])
+        del_any = jnp.any(is_d)
+        # a complete path reaches cell (0, 0) = data row `offset` of col 0
+        reached0 = jnp.any(on & (d == off) & (jc == 0))
+        # seeds for column jc-1: match pred at the same data row, delete
+        # pred one data row down
+        Pnext = is_m | jnp.concatenate([jnp.zeros((1,), bool), is_d[:-1]])
+        return Pnext, (nerr_c, sub_any, ins_any, del_any, reached0)
+
+    js = jnp.arange(T1 - 1, -1, -1, dtype=jnp.int32)
+    P0 = jnp.zeros((K,), bool)
+    _, (nerr_c, sub_any, ins_any, del_any, reached0) = jax.lax.scan(
+        step, P0, js
+    )
+    # scan ran j descending; flip to ascending-j order
+    sub_any, ins_any, del_any = sub_any[::-1], ins_any[::-1], del_any[::-1]
+    nerr = jnp.sum(nerr_c)
+    nerr = jnp.where(jnp.any(reached0), nerr, -1)
+    # column jc emits substitutions/deletions at pos jc-1, insertions at
+    # pos jc: shift the sub/del rows down by one
+    zrow = jnp.zeros((1, 4), bool)
+    sub_t = jnp.concatenate([sub_any[1:], zrow])
+    del_t = jnp.concatenate([del_any[1:], jnp.zeros((1,), bool)])
+    edits = jnp.concatenate(
+        [sub_t, ins_any, del_t[:, None]], axis=1
+    ).astype(jnp.int8)
+    return nerr, edits
+
+
 def traceback_batch(
     moves: np.ndarray,
     geom: BandGeometry,

@@ -26,21 +26,43 @@ from . import align_jax
 from .proposal_dense import _dense_batch
 
 
-@functools.partial(jax.jit, static_argnames=("K",))
-def fused_step(template, seq, match, mismatch, ins, dels, geom, weights, K):
-    """Forward + backward fills and dense all-edit score tables.
+@functools.partial(
+    jax.jit, static_argnames=("K", "want_moves", "want_stats")
+)
+def fused_step_full(
+    template, seq, match, mismatch, ins, dels, geom, weights, K,
+    want_moves=False, want_stats=False,
+):
+    """One driver iteration's full device work in one dispatch.
 
-    Returns (sub [T1, 4], ins [T1, 4], del [T1], total_score) — tables
-    summed over reads with weight masking (psum over a sharded read axis);
-    positions >= the true template length are garbage.
+    Returns (A [N, K, T1], B [N, K, T1], moves [N, K, T1] int8 or None,
+    packed) where `packed` is ONE flat array carrying everything the host
+    needs this iteration (see pack_layout): the weighted total score,
+    per-read scores, per-read traceback error counts and the union
+    edit-indicator table (want_stats), and the dense all-edit score
+    tables. On hardware where every device->host transfer pays a fixed
+    latency (BASELINE.md), fetching one packed array instead of five is
+    the difference between a ~100 ms and a ~500 ms iteration.
+
+    `moves` is only materialized as an output when want_moves (the SCORE
+    stage's host traceback walk); bandwidth adaptation and alignment-
+    derived proposals use the device statistics instead.
+
+    The score tables are summed over reads with weight masking (psum over
+    a sharded read axis); table positions >= the true template length are
+    garbage.
     """
     fwd = jax.vmap(
-        align_jax._forward_one, in_axes=(None, 0, 0, 0, 0, 0, 0, None)
+        align_jax._forward_one,
+        in_axes=(None, 0, 0, 0, 0, 0, 0, None, None),
     )
     bwd = jax.vmap(
         align_jax._backward_one, in_axes=(None, 0, 0, 0, 0, 0, 0, None)
     )
-    A, _, scores = fwd(template, seq, match, mismatch, ins, dels, geom, K)
+    need_moves = want_moves or want_stats
+    A, moves, scores = fwd(
+        template, seq, match, mismatch, ins, dels, geom, K, need_moves
+    )
     B, _ = bwd(template, seq, match, mismatch, ins, dels, geom, K)
     A, B = jax.lax.optimization_barrier((A, B))
     subs, insr, dele = _dense_batch(A, B, seq, match, mismatch, ins, dels, geom)
@@ -51,4 +73,59 @@ def fused_step(template, seq, match, mismatch, ins, dels, geom, weights, K):
         return jnp.sum(jnp.where(w > 0, x, 0.0) * w, axis=0)
 
     total = jnp.sum(jnp.where(weights > 0, scores, 0.0) * weights)
-    return wsum(subs), wsum(insr), wsum(dele), total
+    dtype = scores.dtype
+    parts = [total[None], scores]
+    if want_stats:
+        stats = jax.vmap(
+            align_jax._traceback_stats_one, in_axes=(0, 0, None, 0, None)
+        )
+        nerr, edits = stats(moves, seq, template, geom, K)
+        parts.append(nerr.astype(dtype))
+        # union over reads; a zero-weight padding read duplicates a real
+        # read so its contribution is a no-op for the union
+        edits_any = jnp.max(edits, axis=0)
+        parts.append(edits_any.reshape(-1).astype(dtype))
+    parts += [
+        wsum(subs).reshape(-1),
+        wsum(insr).reshape(-1),
+        wsum(dele),
+    ]
+    packed = jnp.concatenate(parts)
+    if not want_moves:
+        moves = None
+    return A, B, moves, packed
+
+
+def pack_layout(n_reads: int, T1: int, want_stats: bool):
+    """Slice map of fused_step_full's packed array: name -> (start, stop)."""
+    out = {}
+    o = 0
+
+    def take(name, size):
+        nonlocal o
+        out[name] = (o, o + size)
+        o += size
+
+    take("total", 1)
+    take("scores", n_reads)
+    if want_stats:
+        take("n_errors", n_reads)
+        take("edits", T1 * 9)
+    take("sub", T1 * 4)
+    take("ins", T1 * 4)
+    take("del", T1)
+    return out
+
+
+def fused_step(template, seq, match, mismatch, ins, dels, geom, weights, K):
+    """Score-table view of the fused step: (sub, ins, del, total)."""
+    _, _, _, packed = fused_step_full(
+        template, seq, match, mismatch, ins, dels, geom, weights, K
+    )
+    N = seq.shape[0]
+    T1 = template.shape[0] + 1
+    lay = pack_layout(N, T1, False)
+    sub = packed[slice(*lay["sub"])].reshape(T1, 4)
+    insr = packed[slice(*lay["ins"])].reshape(T1, 4)
+    dele = packed[slice(*lay["del"])]
+    return sub, insr, dele, packed[0]

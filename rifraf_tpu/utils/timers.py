@@ -1,0 +1,45 @@
+"""Lightweight named wall-clock timers for driver observability.
+
+The reference has no profiling at all (SURVEY §5); the TPU driver needs
+it because its cost structure is invisible from Python — a slow run can
+be retracing, dispatch overhead, device compute, or host tracebacks, and
+only per-section timing tells them apart.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Tuple
+
+
+class Timers:
+    """name -> (calls, total_seconds); zero-dependency, host wall clock."""
+
+    def __init__(self):
+        self.data: Dict[str, Tuple[int, float]] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        n, s = self.data.get(name, (0, 0.0))
+        self.data[name] = (n + 1, s + seconds)
+
+    @contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def merge(self, other: "Timers") -> None:
+        for name, (n, s) in other.data.items():
+            cn, cs = self.data.get(name, (0, 0.0))
+            self.data[name] = (cn + n, cs + s)
+
+    def summary(self) -> str:
+        lines = []
+        for name, (n, s) in sorted(
+            self.data.items(), key=lambda kv: -kv[1][1]
+        ):
+            lines.append(f"  {name:28s} {n:6d} calls  {s*1e3:10.1f} ms")
+        return "\n".join(lines)

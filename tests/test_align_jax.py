@@ -178,6 +178,64 @@ def test_traceback_matches_oracle():
         assert (at[at >= 0] == t).all()
 
 
+def test_traceback_stats_match_host_walk():
+    """The device scan-based traceback statistics (error counts + edit
+    indicator table) must equal the host pointer-chase walk on the same
+    move bands, over many random shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from rifraf_tpu.engine.generate import moves_to_proposals
+    from rifraf_tpu.engine.proposals import Deletion, Insertion, Substitution
+    from rifraf_tpu.ops.align_jax import _traceback_stats_one
+
+    rng = np.random.default_rng(11)
+    for trial in range(12):
+        tlen = int(rng.integers(8, 40))
+        t = rng.integers(0, 4, size=tlen).astype(np.int8)
+        reads = []
+        for _ in range(4):
+            slen = int(rng.integers(max(4, tlen - 6), tlen + 7))
+            s = rng.integers(0, 4, size=slen).astype(np.int8)
+            log_p = rng.uniform(-3.0, -0.3, size=slen)
+            reads.append(make_read_scores(s, log_p, 4, SCORES))
+        tp = np.pad(t, (0, int(rng.integers(0, 5))))  # bucket padding
+        batch = batch_reads(reads, dtype=np.float64)
+        bands, moves, scores, geom = forward_batch(
+            tp, batch, tlen=tlen, want_moves=True
+        )
+        K = np.asarray(moves).shape[1]
+        stats = jax.vmap(
+            _traceback_stats_one, in_axes=(0, 0, None, 0, None)
+        )
+        nerr, edits = stats(moves, jnp.asarray(batch.seq), jnp.asarray(tp, jnp.int8), geom, K)
+        nerr, edits = np.asarray(nerr), np.asarray(edits)
+        paths = traceback_batch(np.asarray(moves), geom)
+        T1 = np.asarray(moves).shape[2]
+        for k, rs in enumerate(reads):
+            # error count vs host walk on the identical path
+            i = j = errs = 0
+            for m in paths[k]:
+                di, dj = align_np.OFFSETS[m]
+                i += di
+                j += dj
+                if m == align_np.TRACE_MATCH:
+                    errs += int(rs.seq[i - 1] != t[j - 1])
+                else:
+                    errs += 1
+            assert nerr[k] == errs, (trial, k)
+            # edit table vs host moves_to_proposals
+            want = np.zeros((T1, 9), bool)
+            for p in moves_to_proposals(paths[k], t, rs.seq):
+                if isinstance(p, Substitution):
+                    want[p.pos, p.base] = True
+                elif isinstance(p, Insertion):
+                    want[p.pos, 4 + p.base] = True
+                else:
+                    want[p.pos, 8] = True
+            assert (edits[k].astype(bool) == want).all(), (trial, k)
+
+
 def test_trim_and_skew_match_oracle():
     rng = np.random.default_rng(19)
     t, rs = random_case(rng, 20, 14, 5)

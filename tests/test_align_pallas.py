@@ -63,26 +63,16 @@ def test_pallas_backward_matches_xla():
     assert (bp[~np.isfinite(bx)] < -1e30).all()
 
 
-def test_rifraf_backend_pallas_matches_xla():
-    """Full driver with backend="pallas" (interpret mode on CPU): the
-    Pallas fills must produce the identical consensus and a matching
-    score to the XLA backend at float32."""
-    from rifraf_tpu.engine.driver import rifraf
-    from rifraf_tpu.engine.params import RifrafParams
-    from rifraf_tpu.models.errormodel import ErrorModel
-    from rifraf_tpu.sim.sample import sample_sequences
+def test_backend_pallas_rejected():
+    """backend="pallas" was retired from the driver (BASELINE.md): an
+    explicit request must fail loudly, never silently run XLA."""
+    import pytest
 
-    rng = np.random.default_rng(17)
-    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
-        nseqs=5, length=40, error_rate=0.02, rng=rng,
-        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    from rifraf_tpu.engine.realign import BatchAligner
+    from rifraf_tpu.models.sequences import make_read_scores
+
+    read = make_read_scores(
+        np.array([0, 1, 2, 3], np.int8), np.full(4, -2.0), 3, SCORES
     )
-    # len_bucket small keeps interpret-mode shapes tiny
-    base = rifraf(seqs, phreds=phreds,
-                  params=RifrafParams(dtype="float32", backend="xla",
-                                      len_bucket=16))
-    pal = rifraf(seqs, phreds=phreds,
-                 params=RifrafParams(dtype="float32", backend="pallas",
-                                     len_bucket=16))
-    assert np.array_equal(base.consensus, pal.consensus)
-    assert np.isclose(base.state.score, pal.state.score, rtol=1e-4)
+    with pytest.raises(ValueError, match="retired"):
+        BatchAligner([read], dtype=np.float32, backend="pallas")

@@ -230,11 +230,13 @@ def test_bandwidth_growth_never_outruns_final_refill():
     assert aligner.fixed.all()
 
 
-def test_ab_cache_skips_forward_fill_after_accept():
-    """Regression for the dead realign_As=False fast path: resample()
-    rebuilds the batch list object each iteration, so the aligner must
-    compare batch MEMBERSHIP, not list identity (model.jl:928-930's
-    skip-forward-refill optimization)."""
+def test_same_membership_resample_keeps_batch_state():
+    """resample() rebuilds the batch list object each iteration, so the
+    aligner must compare batch MEMBERSHIP, not list identity: an unchanged
+    selection must NOT trigger a set_batch rebuild (which would reset
+    adapted bandwidths and re-stage the batch arrays on device). The fused
+    step always refills both bands — a redundant refill is far cheaper
+    than a second dispatch — so each realign adds exactly one fill."""
     from rifraf_tpu.engine import driver as drv
 
     template, reads = _noisy_reads(n=6, length=90)
@@ -244,16 +246,20 @@ def test_ab_cache_skips_forward_fill_after_accept():
 
     drv.resample(state, params, rng)
     drv.realign_rescore(state, params)
+    batch_obj = state.aligner.batch
     fills = state.aligner.n_forward_fills
     assert fills >= 1
+    assert state.aligner.fixed.all()  # bandwidths settled
 
-    # same membership, fresh list object; realign_As=False must skip the
-    # forward fill entirely
+    # same membership, fresh list object: the device batch must be reused
+    # and the settled bandwidth state must survive
     state.realign_As = False
     state.realign_Bs = True
     drv.resample(state, params, rng)
     drv.realign_rescore(state, params)
-    assert state.aligner.n_forward_fills == fills
+    assert state.aligner.batch is batch_obj
+    assert state.aligner.fixed.all()
+    assert state.aligner.n_forward_fills == fills + 1
 
 
 def test_batch_threshold_validated():

@@ -3,9 +3,11 @@
 Mirrors /root/reference/scripts/rifraf.jl: a glob of FASTQ files, one
 consensus each, FASTA out, with per-file reference lookup via a TSV map.
 Where the reference fans files out over Julia worker processes with `pmap`
-(scripts/rifraf.jl:190-191), this CLI runs the cluster sweep through
-rifraf_tpu.parallel (device-sharded when multiple chips are visible,
-otherwise sequential on one accelerator — the device is the parallelism).
+(scripts/rifraf.jl:190-191), this CLI runs the sweep through
+rifraf_tpu.parallel.cluster.sweep_clusters: one worker thread per visible
+device (override with --jobs), each pinning its clusters to a home device;
+async XLA dispatch overlaps one cluster's host logic with another's device
+fills, and compiled executables are shared across all workers.
 """
 
 from __future__ import annotations
@@ -69,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated reference error ratios - "
                         "mm, ins, del, codon ins, codon del")
     p.add_argument("--max-iters", type=int, default=100)
+    p.add_argument("--jobs", "-j", type=int, default=0,
+                   help="concurrent consensus jobs; 0 = one per visible "
+                        "device (the pmap fan-out of scripts/rifraf.jl)")
     p.add_argument("--verbose", "-v", type=int, default=0)
     p.add_argument("seq_errors", metavar="seq-errors",
                    help="comma-separated sequence error ratios - "
@@ -78,8 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def dofile(path: str, reffile: str, refid: str, args) -> "RifrafResult":
-    """One consensus job (scripts/rifraf.jl:71-120)."""
+def dofile(path: str, reffile: str, refid: str, args,
+           tag_logs: bool = False) -> "RifrafResult":
+    """One consensus job (scripts/rifraf.jl:71-120). ``tag_logs`` prefixes
+    every verbose line with the input filename (concurrent sweeps)."""
     if args.verbose >= 1:
         print(f"reading sequences from '{path}'", file=sys.stderr)
     reference = None
@@ -107,6 +114,10 @@ def dofile(path: str, reffile: str, refid: str, args) -> "RifrafResult":
         ref_scores=ref_scores,
         max_iters=args.max_iters,
         verbose=args.verbose,
+        # concurrent sweep jobs tag their log lines with the input file
+        log_prefix=(
+            f"[{os.path.basename(path)}] " if args.verbose and tag_logs else ""
+        ),
     )
     return rifraf(sequences, phreds=phreds, reference=reference, params=params)
 
@@ -144,9 +155,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         basenames = [os.path.basename(f) for f in infiles]
         refids = [name_to_ref[n] for n in basenames]
 
-    results = [
-        dofile(f, args.reference, rid, args) for f, rid in zip(infiles, refids)
-    ]
+    from ..parallel.cluster import resolve_jobs_flag, sweep_clusters
+
+    n_workers = resolve_jobs_flag(args.jobs, len(infiles))
+    if args.verbose >= 1 and n_workers > 1:
+        print(f"sweeping {len(infiles)} files on {n_workers} workers",
+              file=sys.stderr)
+    results = sweep_clusters(
+        lambda job: dofile(job[0], args.reference, job[1], args,
+                           tag_logs=n_workers > 1),
+        list(zip(infiles, refids)),
+        max_workers=n_workers,
+    )
 
     plen = slen = 0
     if args.keep_unique_name:

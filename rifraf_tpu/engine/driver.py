@@ -19,9 +19,10 @@ import numpy as np
 from ..models.errormodel import Scores
 from ..models.sequences import ReadScores, make_read_scores
 from ..ops import align_np
-from ..utils.constants import CODON_LENGTH
+from ..utils.constants import CODON_LENGTH, decode_seq
 from ..utils.mathops import logsumexp10
 from ..utils.phred import phred_to_log_p, phred_to_p
+from ..utils.timers import Timers
 from .generate import (
     all_proposals,
     has_single_indels,
@@ -84,7 +85,9 @@ class RifrafState:
 
 @dataclass
 class RifrafResult:
-    """model.jl:195-225."""
+    """model.jl:195-225. `timers` is a TPU addition: per-stage wall-clock
+    sections of the run (resample / realign / candidate scoring / device
+    dispatch vs fetch), printed at verbose>=2."""
 
     consensus: np.ndarray
     params: RifrafParams
@@ -92,11 +95,19 @@ class RifrafResult:
     consensus_stages: List[List[np.ndarray]]
     error_probs: Optional[EstimatedProbs] = None
     aln_error_probs: Optional[np.ndarray] = None
+    timers: Optional[Timers] = None
 
 
 def _log(params: RifrafParams, level: int, msg: str) -> None:
     if params.verbose >= level:
-        print(msg, file=sys.stderr)
+        if params.log_prefix:
+            msg = "\n".join(
+                params.log_prefix + line for line in msg.split("\n")
+            )
+        # a single write call (print would issue a second one for the
+        # newline) keeps concurrent sweep jobs from splicing into each
+        # other's lines
+        sys.stderr.write(msg + "\n")
 
 
 _cache_enabled = False
@@ -586,6 +597,7 @@ def rifraf(
     state.realign_As = True
     state.realign_Bs = True
     old_score = -np.inf
+    timers = Timers()
 
     for iteration in range(1, params.max_iters + 1):
         while state.stage < Stage.SCORE and state.stage not in enabled:
@@ -595,9 +607,17 @@ def rifraf(
         state.stage_iterations[int(state.stage) - 1] += 1
         consensus_stages[int(state.stage) - 1].append(state.consensus.copy())
         _log(params, 1, f"iteration {iteration} : {state.stage.name} : {state.score}")
+        # per-iteration consensus dump (model.jl:1164-1168)
+        if params.verbose >= 3:
+            _log(params, 3, f"  consensus: {decode_seq(state.consensus)}")
+        else:
+            _log(params, 2, f"  consensus length: {len(state.consensus)}")
 
-        resample(state, params, rng)
-        realign_rescore(state, params)
+        _log(params, 2, "  step: resample")
+        with timers.time("resample"):
+            resample(state, params, rng)
+        with timers.time("realign_rescore"):
+            realign_rescore(state, params)
 
         if check_score(state, params, old_score, rng):
             old_score = state.score
@@ -606,11 +626,13 @@ def rifraf(
                 indel_seeds = single_indel_proposals(state.consensus, state.reference)
             else:
                 indel_seeds = []
-            candidates = get_candidates(state, params, indel_seeds=indel_seeds)
+            with timers.time("get_candidates"):
+                candidates = get_candidates(state, params, indel_seeds=indel_seeds)
             state.realign_As = True
             if candidates:
                 _log(params, 2, "  step: handle candidates")
-                handle_candidates(candidates, state, params)
+                with timers.time("handle_candidates"):
+                    handle_candidates(candidates, state, params)
             else:
                 finish_stage(state, params)
         else:
@@ -635,16 +657,25 @@ def rifraf(
         params=params,
         state=state,
         consensus_stages=consensus_stages,
+        timers=timers,
     )
     if params.do_score:
         _log(params, 2, "computing consensus quality scores")
         state.realign_As = True
         state.realign_Bs = True
-        realign_rescore(state, params)
-        result.error_probs = estimate_probs(state, params)
-        result.aln_error_probs = alignment_error_probs(
-            len(state.consensus), state.batch_seqs, state.aligner.tracebacks
-        )
+        with timers.time("realign_rescore"):
+            realign_rescore(state, params)
+        with timers.time("estimate_probs"):
+            result.error_probs = estimate_probs(state, params)
+            result.aln_error_probs = alignment_error_probs(
+                len(state.consensus), state.batch_seqs, state.aligner.tracebacks
+            )
+    # fold in the aligner's device-side section timers (fused dispatch,
+    # packed fetch, traceback walk, table readouts)
+    if state.aligner is not None:
+        timers.merge(state.aligner.timers)
+    if params.verbose >= 2:
+        _log(params, 2, "timers:\n" + timers.summary())
     _log(params, 1, f"done. converged: {state.converged}")
     return result
 

@@ -72,6 +72,10 @@ class RifrafParams:
     batch_threshold: float = 0.1
     max_iters: int = 100
     verbose: int = 0
+    # prefix for every verbose log line (TPU addition): the cluster sweep
+    # runs jobs concurrently, so each job tags its lines with its input
+    # file to keep interleaved stderr attributable
+    log_prefix: str = ""
 
     # --- TPU-native additions (no reference equivalent) ---
     # float dtype for device kernels. None resolves per backend at run

@@ -20,6 +20,8 @@ from typing import List, NamedTuple, Sequence, Union
 
 import numpy as np
 
+from ..utils.debug import DEBUG, myassert
+
 
 @dataclass(frozen=True)
 class Substitution:
@@ -102,7 +104,16 @@ def apply_proposals(seq: np.ndarray, proposals: Sequence[Proposal]) -> np.ndarra
             last_del_anchor = a
         n0 = a
     parts.append(seq[n0:])
-    return np.concatenate(parts) if parts else seq.copy()
+    out = np.concatenate(parts) if parts else seq.copy()
+    if DEBUG:  # guard at the call site: the condition itself costs a pass
+        myassert(
+            len(out)
+            == len(seq)
+            + sum(isinstance(p, Insertion) for p in proposals)
+            - sum(isinstance(p, Deletion) for p in proposals),
+            "applied-proposal length mismatch",
+        )
+    return out
 
 
 def choose_candidates(

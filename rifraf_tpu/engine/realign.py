@@ -28,6 +28,7 @@ from ..models.sequences import ReadBatch, ReadScores, batch_reads
 from ..ops import align_jax, align_np
 from ..ops.banded_array import BandedArray
 from ..ops.proposal_jax import score_proposals_batch
+from ..utils.debug import myassert
 from ..utils.mathops import poisson_cquantile
 from ..utils.timers import Timers
 from .params import resolve_dtype, validate_backend
@@ -269,6 +270,11 @@ class BatchAligner:
                 grew = True
             else:
                 self.fixed[k] = True
+        # a stale sharded cache after growth would refill with the OLD
+        # bandwidths while K grew for the new ones (util.jl:7-15-style
+        # DEBUG invariant)
+        myassert(not grew or self._bw_dev is None,
+                 "sharded bandwidth cache not invalidated after growth")
         return grew
 
     def total_score(self, weights: Optional[np.ndarray] = None) -> float:

@@ -1,3 +1,4 @@
+from .cluster import resolve_jobs_flag, sweep_clusters
 from .sharding import (
     READS_AXIS,
     make_mesh,

@@ -1,0 +1,98 @@
+"""Cluster-level parallel sweep: concurrent independent consensus jobs.
+
+The reference fans independent input files out over Julia worker processes
+with ``pmap`` (scripts/rifraf.jl:190-191, Distributed RPC). The TPU-native
+equivalent is NOT process parallelism — XLA dispatch is already
+asynchronous, so one Python process can keep several devices (or one
+device's stream) busy by driving each cluster's hill-climbing loop from its
+own host thread:
+
+- each worker thread pins its jobs to a home device via the thread-local
+  ``jax.default_device`` context, so with D visible devices D clusters run
+  genuinely concurrently (DP over the cluster axis);
+- on a single device the threads still overlap one cluster's host work
+  (proposal generation, candidate filtering, convergence checks) with
+  another cluster's device fills — the dispatch queue is the pipeline;
+- compiled executables are shared process-wide, so shape-bucketed clusters
+  compile once and every thread reuses the cache (a worker-process design
+  would recompile per process).
+
+Determinism: ``rifraf()`` derives all randomness from ``params.seed`` per
+call, so results are bit-identical to a sequential sweep regardless of
+worker count or completion order (asserted in tests/test_cluster.py).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from itertools import cycle
+from typing import Callable, List, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def default_worker_count(n_jobs: int) -> int:
+    """Workers for a sweep: one per visible device (the DP width), but
+    never more than there are jobs, and at least 1. A couple of extra
+    threads beyond the device count would only contend on the host."""
+    import jax
+
+    try:
+        n_dev = len(jax.devices())
+    except RuntimeError:
+        n_dev = 1
+    return max(1, min(n_jobs, n_dev))
+
+
+def sweep_clusters(
+    fn: Callable[[T], R],
+    jobs: Sequence[T],
+    max_workers: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> List[R]:
+    """Run ``fn`` over independent cluster jobs concurrently; results in
+    job order (the ``pmap(dofile, infiles)`` of scripts/rifraf.jl:190-191).
+
+    ``max_workers``: thread count; default = min(n_jobs, n_devices).
+    ``devices``: device list to pin workers to round-robin; default
+    ``jax.devices()``. Pass ``max_workers=1`` for a plain sequential loop
+    (no threads, no device pinning) — useful for debugging.
+    """
+    jobs = list(jobs)
+    if not jobs:
+        return []
+    if max_workers is None:
+        if devices is not None:
+            # one worker per *given* device, not per visible device —
+            # more threads would just contend on the same chips
+            max_workers = max(1, min(len(jobs), len(devices)))
+        else:
+            max_workers = default_worker_count(len(jobs))
+    if max_workers <= 1 or len(jobs) == 1:
+        return [fn(j) for j in jobs]
+
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    dev_iter = cycle(devices)
+    assignments = [next(dev_iter) for _ in jobs]
+
+    def run(job: T, dev) -> R:
+        # jax config context managers are thread-local: pinning here
+        # affects only this worker's dispatches
+        with jax.default_device(dev):
+            return fn(job)
+
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futures = [pool.submit(run, j, d) for j, d in zip(jobs, assignments)]
+        return [f.result() for f in futures]
+
+
+def resolve_jobs_flag(jobs_flag: int, n_files: int) -> int:
+    """CLI --jobs semantics: 0 = auto (one worker per device), else the
+    explicit count capped by the number of files."""
+    if jobs_flag <= 0:
+        return default_worker_count(n_files)
+    return max(1, min(jobs_flag, n_files))

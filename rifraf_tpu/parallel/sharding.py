@@ -10,8 +10,9 @@ replaces that with XLA collectives over ICI:
   `psum` of per-read scores — a single scalar (or [P] vector) reduction
   over ICI per step, inserted automatically by XLA from the sharding
   annotations.
-- **Cluster sharding (DP-like)**: independent consensus jobs (one per
-  cluster/file) sharded across chips, the `pmap` equivalent.
+- **Cluster sweep (DP-like)**: independent consensus jobs (one per
+  cluster/file) driven concurrently, one worker thread per device — the
+  `pmap` equivalent. Implemented in rifraf_tpu.parallel.cluster.
 
 Everything goes through `jax.jit` with `NamedSharding` in/out specs: pick a
 mesh, annotate shardings, let XLA insert collectives.

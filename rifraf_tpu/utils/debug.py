@@ -1,0 +1,22 @@
+"""DEBUG-gated assertions.
+
+Mirrors /root/reference/src/util.jl:7-15 (`DEBUG` + `@myassert`): invariant
+checks that can be disabled. Python has no macros, so ``myassert`` only
+skips the *raise* when the flag is off — its condition argument is still
+evaluated. For invariants whose condition is itself expensive, guard the
+whole call at the call site: ``if debug.DEBUG: myassert(...)``. Disable
+with ``rifraf_tpu.utils.debug.DEBUG = False`` or env ``RIFRAF_TPU_DEBUG=0``
+(read once at import).
+"""
+
+from __future__ import annotations
+
+import os
+
+DEBUG = os.environ.get("RIFRAF_TPU_DEBUG", "1") not in ("0", "false", "no")
+
+
+def myassert(condition: bool, msg: str) -> None:
+    """Raise unless ``condition``, only when DEBUG is on (util.jl:10-15)."""
+    if DEBUG and not condition:
+        raise AssertionError(msg)

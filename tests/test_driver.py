@@ -294,3 +294,39 @@ def test_use_ref_for_qvs_without_frame_builds_reference():
     probs = estimate_point_probs(result.error_probs)
     assert probs.shape == (len(result.consensus),)
     assert np.all((probs >= 0.0) & (probs <= 1.0))
+
+
+def test_verbose3_dumps_consensus_and_timers(capsys):
+    """verbose>=3 prints the full per-iteration consensus (model.jl:1164-
+    1168); verbose>=2 prints the length line and the timer summary."""
+    rng = np.random.default_rng(5)
+    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=4, length=30, error_rate=0.02, rng=rng,
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    r = rifraf(seqs, phreds=phreds, params=RifrafParams(verbose=3))
+    err = capsys.readouterr().err
+    assert "  consensus: " in err
+    assert "timers:" in err
+    assert "realign_rescore" in err
+    assert r.timers is not None
+    assert r.timers.data["realign_rescore"][0] >= 1
+
+    r2 = rifraf(seqs, phreds=phreds, params=RifrafParams(verbose=2))
+    err2 = capsys.readouterr().err
+    assert "  consensus length: " in err2
+    assert "  consensus: " not in err2
+
+
+def test_myassert_gated_by_debug():
+    from rifraf_tpu.utils import debug
+
+    debug.myassert(True, "never raises")
+    with pytest.raises(AssertionError):
+        debug.myassert(False, "boom")
+    saved = debug.DEBUG
+    try:
+        debug.DEBUG = False
+        debug.myassert(False, "gated off")
+    finally:
+        debug.DEBUG = saved

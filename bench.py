@@ -1,23 +1,31 @@
-"""Headline benchmark: the fused consensus step on 1 kb x 256 reads.
+"""Headline benchmark: END-TO-END `rifraf()` consensus, 1 kb x 256 reads.
 
-One step = batched banded forward + backward fills plus dense rescoring of
-ALL 9xLen+4 single-base edits against every read — the per-iteration
-device work of the reference's hill-climbing loop (align.jl:155-212 fills
-+ model.jl:242-285/401-456 rescoring, BASELINE.json config "1 kb template
-x 256 reads"), issued as ONE fused XLA dispatch with device-resident
-inputs (rifraf_tpu.ops.fused).
+Times the actual driver (`rifraf_tpu.engine.driver.rifraf`) — the fused
+per-iteration device step (forward + backward fills + dense all-edits
+rescoring in one dispatch, ops.fused), the packed device->host fetch, and
+all host-side hill-climbing logic — on a seeded simulated problem: 1 kb
+template, 256 phred-scored reads, no read batching (every iteration spans
+the full read set, the one-consensus-per-chip configuration). This is the
+reference's model.jl:679-719 realign + 385-456 rescoring loop, end to end
+until convergence — NOT a microbenchmark of an unwired step.
 
-Timing is honest against runtime-side result reuse: every timed iteration
-uses a slightly perturbed score table (distinct content), and each call is
-individually blocked.
+Timing protocol: one full warm-up run compiles every bucketed shape, then
+`N_TIMED` fresh runs are timed (identical seeded problem; the driver
+recomputes everything — only XLA executables are reused, exactly as in
+production). Reported value is the min.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-`vs_baseline` is the speedup over this repo's measured CPU-backend number:
-the SAME fused-step program on jax-CPU on this host class (multithreaded
-XLA:CPU — a far stronger host baseline than the r1 scan-per-column CPU
-number; see BASELINE.md "measured baselines").
+`vs_baseline` is the speedup over this repo's CPU-backend wall time for
+the IDENTICAL end-to-end run on the dev host class (python bench.py --cpu
+recalibrates; recorded in BASELINE.md "measured baselines").
+
+Other modes (results appended to BASELINE.md, not the driver JSON):
+  --cpu        run the selected mode on the CPU backend
+  --step       the round-2 fused-step microbenchmark (proposal-scores/s)
+  --northstar  2048 x 1 kb and 10 kb x 512 x band-64 end-to-end configs
+  --golden     the shipped-data CLI run (vs the reference's 3.6 s anchor)
 """
 
 import json
@@ -26,46 +34,86 @@ import time
 
 import numpy as np
 
-# CPU-backend measurement of the identical fused step on the dev host
-# (python bench.py --cpu; recorded in BASELINE.md): 1.294 s/step.
+# CPU-backend wall time of the IDENTICAL e2e headline run on the dev host
+# (python bench.py --cpu; see BASELINE.md). Measured 2026-07-30, backend
+# verified "cpu" (the env var alone silently keeps the TPU — see --cpu).
+CPU_E2E_SECONDS = 0.344
+# CPU-backend fused-step time for --step mode (round-2 measurement).
 CPU_BASELINE_STEP_SECONDS = 1.294
 
 TLEN = 1000
 N_READS = 256
-BANDWIDTH = 16
-N_TIMED = 5
+N_TIMED = 3
 
 
-def build_problem():
+def build_e2e_problem(tlen=TLEN, n_reads=N_READS, seed=0, error_rate=0.01):
+    from rifraf_tpu.models.errormodel import ErrorModel
+    from rifraf_tpu.sim.sample import sample_sequences
+
+    rng = np.random.default_rng(seed)
+    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=n_reads, length=tlen, error_rate=error_rate, rng=rng,
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    return template, seqs, phreds
+
+
+def run_e2e(seqs, phreds, bandwidth=None, max_iters=100):
+    """One full consensus; returns (wall_seconds, result)."""
+    from rifraf_tpu.engine.driver import rifraf
+    from rifraf_tpu.engine.params import RifrafParams
+
+    kw = {"batch_size": 0}  # no subsampling: every iteration = all reads
+    if bandwidth is not None:
+        kw["bandwidth"] = bandwidth
+    params = RifrafParams(max_iters=max_iters, **kw)
+    t0 = time.perf_counter()
+    result = rifraf(seqs, phreds=phreds, params=params)
+    return time.perf_counter() - t0, result
+
+
+def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
+                max_iters=100, verbose=False):
+    template, seqs, phreds = build_e2e_problem(tlen, n_reads)
+    walls = []
+    result = None
+    for i in range(n_timed + 1):  # first run compiles
+        wall, result = run_e2e(seqs, phreds, bandwidth=bandwidth,
+                               max_iters=max_iters)
+        if verbose:
+            label = "compile+run" if i == 0 else "warm"
+            print(f"  run {i}: {wall:.2f}s ({label})", file=sys.stderr)
+        if i > 0:
+            walls.append(wall)
+    n_iters = int(result.state.stage_iterations.sum())
+    recovered = bool(np.array_equal(result.consensus, template))
+    return min(walls), n_iters, recovered, result
+
+
+def _step_mode():
+    """Round-2 fused-step microbenchmark (kept for comparability)."""
+    import jax
+    import jax.numpy as jnp
+
     from rifraf_tpu.models.errormodel import ErrorModel, Scores
     from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+    from rifraf_tpu.ops import align_jax
+    from rifraf_tpu.ops.fused import fused_step
 
     scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
     rng = np.random.default_rng(0)
-    template = rng.integers(0, 4, size=TLEN).astype(np.int8)
     reads = []
     for _ in range(N_READS):
         slen = int(rng.integers(950, 1050))
         s = rng.integers(0, 4, size=slen).astype(np.int8)
         log_p = rng.uniform(-3.0, -1.0, size=slen)
-        reads.append(make_read_scores(s, log_p, BANDWIDTH, scores))
-    return template, batch_reads(reads, dtype=np.float32)
-
-
-def measure():
-    import jax
-    import jax.numpy as jnp
-
-    from rifraf_tpu.ops import align_jax
-    from rifraf_tpu.ops.fused import fused_step
-
-    template, batch = build_problem()
-    tlen = TLEN
-    K = align_jax.band_height(batch, tlen)
-    geom = align_jax.batch_geometry(batch, tlen)
+        reads.append(make_read_scores(s, log_p, 16, scores))
+    batch = batch_reads(reads, dtype=np.float32)
+    K = align_jax.band_height(batch, TLEN)
+    geom = align_jax.batch_geometry(batch, TLEN)
+    template = rng.integers(0, 4, size=TLEN).astype(np.int8)
     t_dev = jnp.asarray(np.pad(template, (0, 24)), jnp.int8)
     w = jnp.ones(N_READS, jnp.float32)
-
     base_match = np.asarray(batch.match)
     seq_d = jnp.asarray(batch.seq)
     mm_d = jnp.asarray(batch.mismatch)
@@ -82,28 +130,107 @@ def measure():
         return time.perf_counter() - t0
 
     run(0)  # compile
-    times = [run(i + 1) for i in range(N_TIMED)]
-    return min(times)
+    dt = min(run(i + 1) for i in range(5))
+    P = 4 * TLEN + 4 * (TLEN + 1) + TLEN
+    value = N_READS * P / dt
+    baseline_value = N_READS * P / CPU_BASELINE_STEP_SECONDS
+    print(json.dumps({
+        "metric": "proposal_scores_per_sec_1kb_256reads_fused",
+        "value": round(value, 1),
+        "unit": "proposal-scores/s",
+        "vs_baseline": round(value / baseline_value, 2),
+    }))
+
+
+def _northstar_mode():
+    """The BASELINE.json north-star configs, end to end."""
+    import jax
+
+    backend = jax.default_backend()
+    for label, tlen, n_reads, bandwidth, n_timed in (
+        ("2048x1kb", 1000, 2048, None, 2),
+        ("10kbx512_band64", 10000, 512, 64, 1),
+    ):
+        wall, n_iters, recovered, _ = measure_e2e(
+            tlen, n_reads, bandwidth=bandwidth, n_timed=n_timed, verbose=True
+        )
+        print(json.dumps({
+            "config": label,
+            "backend": backend,
+            "e2e_seconds": round(wall, 3),
+            "iterations": n_iters,
+            "seconds_per_iteration": round(wall / max(n_iters, 1), 4),
+            "template_recovered": recovered,
+        }))
+
+
+def _golden_mode():
+    """Shipped-data CLI run (the reference notebook's 3.6 s anchor)."""
+    import os
+    import tempfile
+
+    from rifraf_tpu.cli.consensus import main as consensus_main
+
+    data = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    argv = [
+        "--reference", os.path.join(data, "references.fasta"),
+        "--reference-map", os.path.join(data, "ref-map.tsv"),
+        "--phred-cap", "30",
+        "1,2,2",
+        os.path.join(data, "input-reads-*.fastq"),
+    ]
+    walls = []
+    for _ in range(3):
+        with tempfile.NamedTemporaryFile(suffix=".fasta") as out:
+            t0 = time.perf_counter()
+            rc = consensus_main(argv + [out.name])
+            walls.append(time.perf_counter() - t0)
+            assert rc == 0
+    print(json.dumps({
+        "config": "shipped_golden_cli_2clusters",
+        "warm_seconds": round(min(walls), 3),
+        "cold_seconds": round(walls[0], 3),
+        "reference_anchor_seconds": 3.6,
+    }))
 
 
 def main():
     if "--cpu" in sys.argv:
         import os
 
-        # force-assign: an ambient JAX_PLATFORMS (e.g. a TPU plugin) would
-        # silently put the "CPU baseline" on the accelerator
+        import jax
+
+        # the env var alone is IGNORED when an accelerator plugin is
+        # ambient (measured on the tunneled-TPU host: JAX_PLATFORMS=cpu
+        # still initialized the TPU); the config option always wins, set
+        # it before anything touches a backend (tests/conftest.py:17-19)
         os.environ["JAX_PLATFORMS"] = "cpu"
-    dt = measure()
-    # every substitution (4xT, incl. identity), insertion (4x(T+1)),
-    # and deletion (T) is scored against every read in the step
-    P = 4 * TLEN + 4 * (TLEN + 1) + TLEN
-    value = N_READS * P / dt
-    baseline_value = N_READS * P / CPU_BASELINE_STEP_SECONDS
+        jax.config.update("jax_platforms", "cpu")
+        if jax.default_backend() != "cpu":
+            raise RuntimeError(
+                f"--cpu requested but backend is {jax.default_backend()}"
+            )
+    if "--step" in sys.argv:
+        _step_mode()
+        return 0
+    if "--northstar" in sys.argv:
+        _northstar_mode()
+        return 0
+    if "--golden" in sys.argv:
+        _golden_mode()
+        return 0
+
+    import jax
+
+    wall, n_iters, recovered, _ = measure_e2e(verbose="--verbose" in sys.argv)
     out = {
-        "metric": "proposal_scores_per_sec_1kb_256reads_fused",
-        "value": round(value, 1),
-        "unit": "proposal-scores/s",
-        "vs_baseline": round(value / baseline_value, 2),
+        "metric": "rifraf_e2e_1kb_256reads_seconds",
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round(CPU_E2E_SECONDS / wall, 2),
+        "iterations": n_iters,
+        "template_recovered": recovered,
+        "backend": jax.default_backend(),
     }
     print(json.dumps(out))
     return 0

@@ -87,8 +87,11 @@ def dofile(path: str, reffile: str, refid: str, args,
            tag_logs: bool = False) -> "RifrafResult":
     """One consensus job (scripts/rifraf.jl:71-120). ``tag_logs`` prefixes
     every verbose line with the input filename (concurrent sweeps)."""
+    prefix = f"[{os.path.basename(path)}] " if tag_logs else ""
     if args.verbose >= 1:
-        print(f"reading sequences from '{path}'", file=sys.stderr)
+        # single atomic write, same tagging as the driver's _log: this line
+        # interleaves with other workers' output in a concurrent sweep
+        sys.stderr.write(f"{prefix}reading sequences from '{path}'\n")
     reference = None
     if reffile:
         ref_records = read_fasta_records(reffile)
@@ -115,9 +118,7 @@ def dofile(path: str, reffile: str, refid: str, args,
         max_iters=args.max_iters,
         verbose=args.verbose,
         # concurrent sweep jobs tag their log lines with the input file
-        log_prefix=(
-            f"[{os.path.basename(path)}] " if args.verbose and tag_logs else ""
-        ),
+        log_prefix=prefix if args.verbose else "",
     )
     return rifraf(sequences, phreds=phreds, reference=reference, params=params)
 

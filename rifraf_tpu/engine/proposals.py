@@ -20,7 +20,7 @@ from typing import List, NamedTuple, Sequence, Union
 
 import numpy as np
 
-from ..utils.debug import DEBUG, myassert
+from ..utils import debug
 
 
 @dataclass(frozen=True)
@@ -105,8 +105,10 @@ def apply_proposals(seq: np.ndarray, proposals: Sequence[Proposal]) -> np.ndarra
         n0 = a
     parts.append(seq[n0:])
     out = np.concatenate(parts) if parts else seq.copy()
-    if DEBUG:  # guard at the call site: the condition itself costs a pass
-        myassert(
+    # module-attribute lookup so the runtime toggle works; guard at the
+    # call site because the condition itself costs a pass over proposals
+    if debug.DEBUG:
+        debug.myassert(
             len(out)
             == len(seq)
             + sum(isinstance(p, Insertion) for p in proposals)

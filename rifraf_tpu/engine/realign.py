@@ -87,6 +87,7 @@ class BatchAligner:
         self.weights = None
         self._weights_dev = None
         self._bw_dev = None  # sharded bandwidth cache (mesh path)
+        self._bw_dev_host = None  # host mirror of _bw_dev for staleness checks
         self._lengths_host = np.asarray(batch.lengths)
         if self.mesh is not None:
             from ..parallel.sharding import pad_batch_to, shard_batch, shard_read_axis
@@ -123,6 +124,7 @@ class BatchAligner:
         self._tables_host = None
         self._total = None
         self.edits_seen = None
+        self._realign_key = None  # memo key of the last completed realign
 
     def _padded_template(self, consensus: np.ndarray) -> np.ndarray:
         T = _bucket(len(consensus) + 1, self.len_bucket)
@@ -141,6 +143,12 @@ class BatchAligner:
                 from ..parallel.sharding import shard_read_axis
 
                 self._bw_dev = shard_read_axis(bw, self.mesh)
+                self._bw_dev_host = bw.copy()
+            # a stale sharded copy here would refill the bands with OLD
+            # bandwidths after growth doubled them (util.jl:7-15-style
+            # DEBUG invariant, checked at the consumption point)
+            myassert(np.array_equal(self._bw_dev_host, self.bandwidths),
+                     "sharded bandwidth cache is stale")
             bw = self._bw_dev
         return self.batch._replace(bandwidth=bw)
 
@@ -177,6 +185,17 @@ class BatchAligner:
 
         t = self._padded_template(consensus)
         tlen = len(consensus)
+        # memoization: the driver re-realigns at the top of every
+        # iteration, but after an accepted candidate the consensus, batch,
+        # and bandwidths are exactly what the post-accept realign already
+        # filled. Skipping the redundant dispatch+fetch matters doubly on
+        # hardware where every device->host fetch pays a fixed ~100 ms
+        # round trip (BASELINE.md "tunneled TPU" measurements) — this is
+        # the realign_As/realign_Bs dirty-flag fast path of model.jl:
+        # 689-703, keyed on content instead of flags.
+        key = (t.tobytes(), tlen, want_moves, want_stats)
+        if key == self._realign_key and bool(self.fixed.all()):
+            return
         self._tlen = tlen
         T1 = len(t) + 1
         weights = self._weights_dev
@@ -247,6 +266,7 @@ class BatchAligner:
             if not grew:
                 self.fixed[:] = True
                 break
+        self._realign_key = key
 
     def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float,
                               entry_bw: np.ndarray) -> bool:
@@ -270,11 +290,6 @@ class BatchAligner:
                 grew = True
             else:
                 self.fixed[k] = True
-        # a stale sharded cache after growth would refill with the OLD
-        # bandwidths while K grew for the new ones (util.jl:7-15-style
-        # DEBUG invariant)
-        myassert(not grew or self._bw_dev is None,
-                 "sharded bandwidth cache not invalidated after growth")
         return grew
 
     def total_score(self, weights: Optional[np.ndarray] = None) -> float:

@@ -25,7 +25,7 @@ worker count or completion order (asserted in tests/test_cluster.py).
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
-from itertools import cycle
+from queue import Queue
 from typing import Callable, List, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
@@ -76,17 +76,25 @@ def sweep_clusters(
 
     if devices is None:
         devices = jax.devices()
-    dev_iter = cycle(devices)
-    assignments = [next(dev_iter) for _ in jobs]
+    # dynamic checkout rather than static round-robin: with uneven job
+    # sizes a static assignment can stack queued jobs on a busy device
+    # while others sit idle
+    free: Queue = Queue()
+    for i in range(max_workers):
+        free.put(devices[i % len(devices)])
 
-    def run(job: T, dev) -> R:
-        # jax config context managers are thread-local: pinning here
-        # affects only this worker's dispatches
-        with jax.default_device(dev):
-            return fn(job)
+    def run(job: T) -> R:
+        dev = free.get()
+        try:
+            # jax config context managers are thread-local: pinning here
+            # affects only this worker's dispatches
+            with jax.default_device(dev):
+                return fn(job)
+        finally:
+            free.put(dev)
 
     with ThreadPoolExecutor(max_workers=max_workers) as pool:
-        futures = [pool.submit(run, j, d) for j, d in zip(jobs, assignments)]
+        futures = [pool.submit(run, j) for j in jobs]
         return [f.result() for f in futures]
 
 

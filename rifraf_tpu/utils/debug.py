@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import os
 
-DEBUG = os.environ.get("RIFRAF_TPU_DEBUG", "1") not in ("0", "false", "no")
+DEBUG = os.environ.get("RIFRAF_TPU_DEBUG", "1").lower() not in (
+    "0", "false", "no", "off"
+)
 
 
 def myassert(condition: bool, msg: str) -> None:

@@ -2,8 +2,6 @@
 CLI fan-out path (the reference's pmap over files, scripts/rifraf.jl:190-191).
 """
 
-import os
-
 import numpy as np
 
 from rifraf_tpu.cli.consensus import main as consensus_main

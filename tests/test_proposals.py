@@ -6,6 +6,8 @@ must exactly equal a full realignment of the edited template — for the numpy
 oracle and for the batched JAX scorer.
 """
 
+import zlib
+
 import numpy as np
 import pytest
 
@@ -89,15 +91,6 @@ SCORES = Scores.from_error_model(ErrorModel(1.0, 5.0, 5.0))
 CODON_SCORES = Scores.from_error_model(ErrorModel(2.0, 0.5, 0.5, 1.0, 1.0))
 
 
-def random_proposal(rng, tlen):
-    kind = rng.integers(0, 3)
-    if kind == 0:
-        return Substitution(int(rng.integers(0, tlen)), int(rng.integers(0, 4)))
-    if kind == 1:
-        return Insertion(int(rng.integers(0, tlen + 1)), int(rng.integers(0, 4)))
-    return Deletion(int(rng.integers(0, tlen)))
-
-
 def full_rescore(template, proposal, rs):
     """Oracle: apply the proposal and realign from scratch."""
     new_t = apply_proposals(template, [proposal])
@@ -125,17 +118,36 @@ def mutate_read(rng, template, sub_p=0.05, indel_p=0.02):
     return np.array(out, dtype=np.int8)
 
 
-@pytest.mark.parametrize("use_codon", [False, True])
-def test_rescoring_trick_equals_full_realignment_np(use_codon):
-    """The exactness property (test_model.jl:39-153), numpy oracle.
+def _make_sub(rng, tlen):
+    return Substitution(int(rng.integers(0, tlen)), int(rng.integers(0, 4)))
 
-    Mirrors the reference's conditions: reads drawn near the template,
-    bandwidth = max(5 * |len(t) - len(s)|, 30)."""
-    rng = np.random.default_rng(1234)
-    scores = CODON_SCORES if use_codon else SCORES
-    n_cases = 60
-    for _ in range(n_cases):
-        tlen = int(rng.integers(30, 50))
+
+def _make_ins(rng, tlen):
+    return Insertion(int(rng.integers(0, tlen + 1)), int(rng.integers(0, 4)))
+
+
+def _make_del(rng, tlen):
+    return Deletion(int(rng.integers(0, tlen)))
+
+
+def _run_rescoring_property(make_proposal, n_cases, seed,
+                            proposals_per_template=4):
+    """The exactness property (test_model.jl:39-153), numpy oracle:
+    O(band) rescoring of a proposal == full realignment of the edited
+    template. Mirrors the reference's conditions — reads drawn near the
+    template, bandwidth = max(5 * |len(t) - len(s)|, 30), codon moves
+    coin-flipped per case (test_model.jl:47-53). The reference scores one
+    proposal per fresh template x read; here each template/read pair
+    scores several proposals (the A/B fills are shared; each proposal
+    still gets its own from-scratch realignment oracle), keeping the same
+    number of scored-proposal comparisons in a fraction of the fills."""
+    rng = np.random.default_rng(seed)
+    n_templates = (n_cases + proposals_per_template - 1) // proposals_per_template
+    done = 0
+    for _ in range(n_templates):
+        tlen = int(rng.integers(30, 51))
+        use_codon = bool(rng.integers(0, 2))
+        scores = CODON_SCORES if use_codon else SCORES
         template = rng.integers(0, 4, size=tlen).astype(np.int8)
         s = mutate_read(rng, template)
         log_p = rng.uniform(-2.0, -1.0, size=len(s))
@@ -143,13 +155,41 @@ def test_rescoring_trick_equals_full_realignment_np(use_codon):
         rs = make_read_scores(s, log_p, bandwidth, scores)
         A = align_np.forward(template, rs)
         B = align_np.backward(template, rs)
-        proposal = random_proposal(rng, tlen)
-        got = score_proposal(proposal, A, B, template, rs)
-        want = full_rescore(template, proposal, rs)
-        np.testing.assert_allclose(
-            got, want, rtol=1e-9, atol=1e-9,
-            err_msg=f"{proposal} tlen={tlen} slen={len(s)} codon={use_codon}",
-        )
+        for _ in range(min(proposals_per_template, n_cases - done)):
+            proposal = make_proposal(rng, tlen)
+            got = score_proposal(proposal, A, B, template, rs)
+            want = full_rescore(template, proposal, rs)
+            np.testing.assert_allclose(
+                got, want, rtol=1e-9, atol=1e-9,
+                err_msg=(f"{proposal} tlen={tlen} slen={len(s)} "
+                         f"codon={use_codon}"),
+            )
+            done += 1
+
+
+@pytest.mark.parametrize("kind,make_proposal", [
+    ("substitution", _make_sub),
+    ("insertion", _make_ins),
+    ("deletion", _make_del),
+])
+def test_rescoring_property_1000_random(kind, make_proposal):
+    """1000 random cases per proposal type (test_model.jl:86-108)."""
+    _run_rescoring_property(make_proposal, 1000, seed=zlib.crc32(kind.encode()))
+
+
+@pytest.mark.parametrize("kind,make_proposal", [
+    ("del_begin", lambda rng, tlen: Deletion(0)),
+    ("del_end", lambda rng, tlen: Deletion(tlen - 1)),
+    ("sub_begin", lambda rng, tlen: Substitution(0, int(rng.integers(0, 4)))),
+    ("sub_end",
+     lambda rng, tlen: Substitution(tlen - 1, int(rng.integers(0, 4)))),
+    ("ins_begin", lambda rng, tlen: Insertion(0, int(rng.integers(0, 4)))),
+    ("ins_end", lambda rng, tlen: Insertion(tlen, int(rng.integers(0, 4)))),
+])
+def test_rescoring_property_edges(kind, make_proposal):
+    """10 cases per edge position kind (test_model.jl:109-153)."""
+    _run_rescoring_property(make_proposal, 10, seed=zlib.crc32(kind.encode()),
+                            proposals_per_template=1)
 
 
 def test_rescoring_trick_equals_full_realignment_jax():
@@ -179,7 +219,7 @@ def test_rescoring_trick_equals_full_realignment_jax():
     got = np.asarray(score_proposals_batch(A, B, batch, geom, proposals))
     assert got.shape == (len(reads), len(proposals))
     for k, rs in enumerate(reads):
-        for p_idx in range(0, len(proposals), 7):  # subsample for speed
+        for p_idx in range(len(proposals)):  # every proposal, every read
             want = full_rescore(template, proposals[p_idx], rs)
             np.testing.assert_allclose(
                 got[k, p_idx], want, rtol=1e-9, atol=1e-9,

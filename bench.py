@@ -37,7 +37,7 @@ import numpy as np
 # CPU-backend wall time of the IDENTICAL e2e headline run on the dev host
 # (python bench.py --cpu; see BASELINE.md). Measured 2026-07-30, backend
 # verified "cpu" (the env var alone silently keeps the TPU — see --cpu).
-CPU_E2E_SECONDS = 0.344
+CPU_E2E_SECONDS = 21.53
 # CPU-backend fused-step time for --step mode (round-2 measurement).
 CPU_BASELINE_STEP_SECONDS = 1.294
 
@@ -63,7 +63,11 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100):
     from rifraf_tpu.engine.driver import rifraf
     from rifraf_tpu.engine.params import RifrafParams
 
-    kw = {"batch_size": 0}  # no subsampling: every iteration = all reads
+    # no subsampling and no fixed top-k INIT batch: every iteration fills
+    # and rescores ALL reads (with defaults, a no-reference run stays in
+    # INIT on the top-batch_fixed_size reads only — that would benchmark
+    # 5-read fills regardless of n_reads)
+    kw = {"batch_size": 0, "batch_fixed": False}
     if bandwidth is not None:
         kw["bandwidth"] = bandwidth
     params = RifrafParams(max_iters=max_iters, **kw)

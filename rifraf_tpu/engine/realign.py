@@ -37,9 +37,26 @@ from .scoring_np import score_proposal as score_proposal_np
 
 MAX_BANDWIDTH_DOUBLINGS = 5  # model.jl:650: bandwidth * 2^5 cap
 
+# HBM working-set budget for one fused step: band buffers (A, B, moves)
+# plus XLA's transient copies scale with reads x K x T1; beyond this the
+# read axis is processed in sequential chunks (ops.fused read_chunk)
+FUSED_HBM_BUDGET = 8e9
+_BYTES_PER_CELL = 22  # A+B f32, moves int8, ~2 transient copies
+
 
 def _bucket(n: int, b: int) -> int:
     return ((n + b - 1) // b) * b
+
+
+def _pick_read_chunk(n: int, K: int, T1: int) -> int:
+    """Chunk size whose fused working set fits the budget (ceil division
+    over the fewest chunks — ops.fused pads the read axis to a multiple);
+    0 = no chunking needed."""
+    per_read = K * T1 * _BYTES_PER_CELL
+    if n * per_read <= FUSED_HBM_BUDGET:
+        return 0
+    n_chunks = -(-(n * per_read) // int(FUSED_HBM_BUDGET))
+    return max(1, -(-n // n_chunks))
 
 
 class BatchAligner:
@@ -215,6 +232,12 @@ class BatchAligner:
             adapting = not bool(self.fixed.all())
             stats_now = want_stats or adapting
             self.n_forward_fills += 1
+            # sequential read chunks bound HBM for big problems; never
+            # under a mesh (the read axis is already sharded across chips)
+            chunk = (
+                0 if self.mesh is not None
+                else _pick_read_chunk(self.batch.n_reads, K, T1)
+            )
             with self.timers.time("fused_dispatch"):
                 A, B, moves, packed = fused_step_full(
                     t_dev,
@@ -228,6 +251,7 @@ class BatchAligner:
                     K,
                     want_moves,
                     stats_now,
+                    chunk,
                 )
             self.A_bands, self.B_bands = A, B
             self.moves, self.geom = moves, geom

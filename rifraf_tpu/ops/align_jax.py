@@ -98,6 +98,58 @@ def _fill_column(cand, g, valid):
     return jnp.where(valid, F, NEG_INF)
 
 
+def _pick_unroll(T: int, cap: int = 16) -> int:
+    """Largest power of two <= cap dividing T (template lengths are
+    bucketed to multiples of 64 by the engine, so this is normally 16;
+    odd ad-hoc lengths just fall back to 1)."""
+    c = 1
+    while c < cap and T % (c * 2) == 0:
+        c *= 2
+    return c
+
+
+class BandTables(NamedTuple):
+    """Per-base score tables pre-shifted into band layout: entry [d, j]
+    holds the table value the DP needs at data row d of column j, i.e.
+    index ``si = d + j - offset - 1`` (sb/mt/mm/gi) or ``i = d + j -
+    offset`` (dl). Built with K contiguous dynamic slices — fancy-index
+    gathers measured ~1600x slower than slice builds on the available TPU
+    (BASELINE.md), and per-column gathers inside the scan were the
+    dominant cost of the whole fill."""
+
+    sb: jnp.ndarray  # int8 [K, T1] read base at si
+    mt: jnp.ndarray  # [K, T1] match score at si
+    mm: jnp.ndarray  # [K, T1] mismatch score at si
+    gi: jnp.ndarray  # [K, T1] insertion score at si
+    dl: jnp.ndarray  # [K, T1] deletion score at i
+
+
+def band_tables(seq, match, mismatch, ins, dels, offset, K: int, T1: int):
+    """Pre-shift the per-base tables into band layout (see BandTables).
+
+    ``offset`` may be a traced per-read scalar; out-of-range entries read
+    zero, which every consumer masks (the same cells the clipped-gather
+    formulation masked)."""
+    num = jnp.stack([match, mismatch, ins])  # [3, L]
+    num = jnp.pad(num, ((0, 0), (K, K + T1)))
+    dlp = jnp.pad(dels, (K - 1, K + T1))
+    sqp = jnp.pad(seq, (K, K + T1))
+    rows3, rowsd, rowss = [], [], []
+    for d in range(K):
+        start = jnp.asarray(K + d - offset - 1, jnp.int32)
+        rows3.append(jax.lax.dynamic_slice(num, (jnp.int32(0), start), (3, T1)))
+        rowsd.append(jax.lax.dynamic_slice(dlp, (start,), (T1,)))
+        rowss.append(jax.lax.dynamic_slice(sqp, (start,), (T1,)))
+    num_t = jnp.stack(rows3)  # [K, 3, T1]
+    return BandTables(
+        sb=jnp.stack(rowss),
+        mt=num_t[:, 0],
+        mm=num_t[:, 1],
+        gi=num_t[:, 2],
+        dl=jnp.stack(rowsd),
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("K", "want_moves", "trim", "skew_matches")
 )
@@ -120,44 +172,57 @@ def _forward_one(
     TRACE_NONE when want_moves=False.
     """
     T = t.shape[0]
-    L = seq.shape[0]
     dtype = match.dtype
-    d = jnp.arange(K, dtype=jnp.int32)
+    T1 = T + 1
 
-    def ins_chain(i, valid, j):
-        """Per-row insert-entry scores g[d] for column j (align.jl:66, 73-76)."""
-        si = jnp.clip(i - 1, 0, L - 1)
-        g = ins[si]
+    # Stack + pad the per-base tables once; each column then reads its
+    # [K]-windows with ONE contiguous dynamic_slice (the band's row
+    # indices i = d + j - off are consecutive in d). Fancy-index gathers
+    # here measured ~1600x slower than contiguous slices (BASELINE.md
+    # round 3); materializing full [K, T1] shifted tables instead blows
+    # HBM at 10 kb x 512 reads. dl is padded one element less so the same
+    # window start yields index i for it and i-1 for the others.
+    Wpad = K + T1
+    # four SEPARATE padded 1-D tables: stacking them [4, Lp] makes XLA
+    # tile the size-4 axis to its (8, 128) layout unit under vmap — a
+    # measured 128x memory expansion that OOMs the 10 kb x 512 config
+    mt_pad = jnp.pad(match, (K, Wpad))
+    mm_pad = jnp.pad(mismatch, (K, Wpad))
+    gi_pad = jnp.pad(ins, (K, Wpad))
+    dl_pad = jnp.pad(dels, (K - 1, Wpad))  # dels is [L+1]: same length
+    sq_pad = jnp.pad(seq, (K, Wpad))
+    tb_cols = jnp.concatenate([t[:1], t])  # [T1]; column j reads t[j-1]
+
+    def read_windows(j, width):
+        start = jnp.asarray(K + j - geom.offset - 1, jnp.int32)
+        sl = lambda a: jax.lax.dynamic_slice(a, (start,), (width,))
+        return sl(sq_pad), sl(mt_pad), sl(mm_pad), sl(gi_pad), sl(dl_pad)
+
+    def make_col(prev, j, sb, mt, mm, gi, dl, tb, first):
+        i, valid = _column_cells(geom, K, j)
+        g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
         if trim:
             g = jnp.where((j == 0) | (j == geom.tlen), jnp.zeros_like(g), g)
-        return jnp.where((i >= 1) & valid, g, jnp.zeros_like(g))
-
-    # column 0: cell (0, 0) = 0; rows below filled by the insert chain
-    i0, valid0 = _column_cells(geom, K, 0)
-    cand0 = jnp.where(i0 == 0, jnp.zeros((K,), dtype), NEG_INF)
-    g0 = ins_chain(i0, valid0, 0)
-    col0 = _fill_column(cand0, g0, valid0)
-    moves0 = jnp.where(
-        (i0 > 0) & (col0 > NEG_INF), TRACE_INSERT, TRACE_NONE
-    ).astype(jnp.int8)
-
-    skew = jnp.asarray(0.99 if skew_matches else 1.0, dtype)
-
-    def step(prev, j):
-        i, valid = _column_cells(geom, K, j)
-        tb = t[jnp.clip(j - 1, 0, T - 1)]
-        si = jnp.clip(i - 1, 0, L - 1)
-        sb = seq[si]
-        match_sc = jnp.where(sb == tb, match[si], mismatch[si] * skew)
-        # match from (i-1, j-1): same data row of the previous column
-        mcand = jnp.where(i >= 1, prev + match_sc, NEG_INF)
-        # delete from (i, j-1): data row d+1 of the previous column
-        prev_up = jnp.concatenate([prev[1:], jnp.full((1,), NEG_INF, dtype)])
-        dcand = prev_up + dels[jnp.clip(i, 0, L)]
-        cand = jnp.maximum(mcand, dcand)
-        g = ins_chain(i, valid, j)
+        if first:
+            # column 0: cell (0, 0) = 0; rows below filled by the chain
+            cand = jnp.where(i == 0, jnp.zeros((K,), dtype), NEG_INF)
+            mcand = dcand = jnp.full((K,), NEG_INF, dtype)
+        else:
+            match_sc = jnp.where(sb == tb, mt, mm * skew)
+            # match from (i-1, j-1): same data row of the previous column
+            mcand = jnp.where(i >= 1, prev + match_sc, NEG_INF)
+            # delete from (i, j-1): data row d+1 of the previous column
+            prev_up = jnp.concatenate(
+                [prev[1:], jnp.full((1,), NEG_INF, dtype)]
+            )
+            dcand = prev_up + dl
+            cand = jnp.maximum(mcand, dcand)
         col = _fill_column(cand, g, valid)
-        if want_moves:
+        if want_moves and first:
+            move = jnp.where(
+                (i > 0) & (col > NEG_INF), TRACE_INSERT, TRACE_NONE
+            ).astype(jnp.int8)
+        elif want_moves:
             shifted = jnp.concatenate([jnp.full((1,), NEG_INF, dtype), col[:-1]])
             icand = shifted + g
             # tie-break priority matches the reference helper call order:
@@ -169,9 +234,43 @@ def _forward_one(
             move = jnp.where(valid & (col > NEG_INF), move, TRACE_NONE)
         else:
             move = jnp.zeros((K,), jnp.int8)
-        return col, (col, move)
+        return col, move
 
-    _, (cols, mv) = jax.lax.scan(step, col0, jnp.arange(1, T + 1, dtype=jnp.int32))
+    skew = jnp.asarray(0.99 if skew_matches else 1.0, dtype)
+    sb0, mt0, mm0, gi0, dl0 = read_windows(jnp.int32(0), K)
+    col0, moves0 = make_col(
+        None, jnp.int32(0), sb0, mt0, mm0, gi0, dl0, tb_cols[0], True,
+    )
+
+    # unroll C columns of straight-line elementwise code per scan step:
+    # a single-column step body is too small to amortize per-step launch
+    # overheads
+    C = _pick_unroll(T)
+
+    def step(prev, xs):
+        j, tb = xs
+        # consecutive columns' [K]-windows overlap: ONE [K + C - 1] slice
+        # per table per block, static sub-windows per column
+        sqw, mtw, mmw, giw, dlw = read_windows(j[0], K + C - 1)
+        cols, mvs = [], []
+        for u in range(C):
+            col, move = make_col(
+                prev, j[u], sqw[u : u + K], mtw[u : u + K],
+                mmw[u : u + K], giw[u : u + K], dlw[u : u + K],
+                tb[u], False,
+            )
+            prev = col
+            cols.append(col)
+            mvs.append(move)
+        return prev, (jnp.stack(cols), jnp.stack(mvs))
+
+    xs = (
+        jnp.arange(1, T + 1, dtype=jnp.int32).reshape(T // C, C),
+        tb_cols[1:].reshape(T // C, C),
+    )
+    _, (cols, mv) = jax.lax.scan(step, col0, xs)
+    cols = cols.reshape(T, K)
+    mv = mv.reshape(T, K)
     band = jnp.concatenate([col0[None, :], cols], axis=0).T  # [K, T+1]
     moves = jnp.concatenate([moves0[None, :], mv], axis=0).T
     d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
@@ -353,21 +452,23 @@ def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
     hardware), and a per-read while_loop walk measured ~100x slower than
     this scan at 10 kb templates.
     """
-    L = seq.shape[0]
     T1 = moves.shape[1]
     d = jnp.arange(K, dtype=jnp.int32)
     off = geom.offset
     d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
+    # padded read bases + per-column template bases: the scan body reads
+    # its [K]-windows with contiguous slices, no gathers (see _forward_one)
+    sqp = jnp.pad(seq, (K, K + T1))
+    tb_cols = jnp.concatenate([t[:1], t])[:T1]
 
-    def step(P, jc):
-        Mj = moves[:, jc]
+    def step(P, x):
+        jc, Mj, sb, tb = x
+        sb = sb.astype(jnp.int32)
         # inject the end-cell seed at the last true column; carried seeds
         # for padded columns (jc > tlen) are all-False so they emit nothing
         seed = P | ((jc == geom.tlen) & (d == d_end))
         on = _resolve_insert_chain(seed, Mj == TRACE_INSERT)
         i = d + jc - off
-        sb = seq[jnp.clip(i - 1, 0, L - 1)].astype(jnp.int32)
-        tb = t[jnp.clip(jc - 1, 0, t.shape[0] - 1)]
         is_m = on & (Mj == TRACE_MATCH)
         is_i = on & (Mj == TRACE_INSERT)
         is_d = on & (Mj == TRACE_DELETE)
@@ -383,11 +484,50 @@ def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
         Pnext = is_m | jnp.concatenate([jnp.zeros((1,), bool), is_d[:-1]])
         return Pnext, (nerr_c, sub_any, ins_any, del_any, reached0)
 
-    js = jnp.arange(T1 - 1, -1, -1, dtype=jnp.int32)
-    P0 = jnp.zeros((K,), bool)
-    _, (nerr_c, sub_any, ins_any, del_any, reached0) = jax.lax.scan(
-        step, P0, js
+    # unroll C columns per scan step (see _forward_one: per-step [K]
+    # work cannot amortize the TPU scan-step overhead). The scan covers
+    # columns T1-1 .. 1 (T of them, divisible by the unroll); column 0 is
+    # the tail call below.
+    C = _pick_unroll(T1 - 1)
+
+    def block(P, xs):
+        jc, tb = xs
+        # columns descend: j[u] = j[0] - u; one [K + C - 1] slice covers
+        # the whole block's read-base windows, one [K, C] slice the
+        # block's move columns (a transposed xs feed of the move band
+        # would materialize a second copy of it)
+        start = jnp.asarray(K + jc[0] - off - 1 - (C - 1), jnp.int32)
+        sqw = jax.lax.dynamic_slice(sqp, (start,), (K + C - 1,))
+        mv_blk = jax.lax.dynamic_slice(
+            moves, (jnp.int32(0), jnp.asarray(jc[0] - (C - 1), jnp.int32)),
+            (K, C),
+        )
+        outs = []
+        for u in range(C):
+            sb = sqw[C - 1 - u : C - 1 - u + K]
+            P, out = step(P, (jc[u], mv_blk[:, C - 1 - u], sb, tb[u]))
+            outs.append(out)
+        return P, tuple(jnp.stack(o) for o in zip(*outs))
+
+    js = jnp.arange(T1 - 1, 0, -1, dtype=jnp.int32).reshape((T1 - 1) // C, C)
+    xs = (
+        js,
+        tb_cols[:0:-1].reshape((T1 - 1) // C, C),
     )
+    P0 = jnp.zeros((K,), bool)
+    Pend, (nerr_c, sub_any, ins_any, del_any, reached0) = jax.lax.scan(
+        block, P0, xs
+    )
+    sb_col0 = jax.lax.dynamic_slice(sqp, (jnp.asarray(K - off - 1, jnp.int32),), (K,))
+    _, (nerr0, sub0, ins0, del0, reached0_0) = step(
+        Pend, (jnp.int32(0), moves[:, 0], sb_col0, tb_cols[0])
+    )
+    flat = lambda x: x.reshape((T1 - 1,) + x.shape[2:])
+    nerr_c = jnp.concatenate([flat(nerr_c), nerr0[None]])
+    sub_any = jnp.concatenate([flat(sub_any), sub0[None]])
+    ins_any = jnp.concatenate([flat(ins_any), ins0[None]])
+    del_any = jnp.concatenate([flat(del_any), del0[None]])
+    reached0 = jnp.concatenate([flat(reached0), reached0_0[None]])
     # scan ran j descending; flip to ascending-j order
     sub_any, ins_any, del_any = sub_any[::-1], ins_any[::-1], del_any[::-1]
     nerr = jnp.sum(nerr_c)

@@ -5,14 +5,17 @@ One driver iteration's device work (the hill-climbing loop's inner step,
 as a single XLA program: batched banded forward and backward fills, then
 the dense all-edits scorer over the fresh bands, then the weighted
 read-axis reduction — device-resident inputs in, three small score tables
-and a scalar out. Fusing eliminates the per-call host->device transfers
-and dispatch round trips that dominate the unfused chain (BASELINE.md:
-~11 ms unfused vs ~0.15 ms fused at 1 kb x 256 reads on TPU v5e).
+and a scalar out. Fusing eliminates per-call host<->device transfers,
+which cost a fixed ~100 ms round trip EACH on the tunneled TPU
+(BASELINE.md round 3; earlier sub-ms "fused step" numbers were async
+measurement artifacts — the honest dependent-chain time at 1 kb x 256
+reads is ~0.4 s, dominated by per-column kernel overheads).
 
 The `optimization_barrier` between the fills and the dense sweep is
 load-bearing: without it XLA fuses the dense scorer's band-wide consumers
 into the column scans and the schedule collapses (measured ~4.6 s per
-step — 30,000x slower).
+step vs the ~0.4 s honest baseline — ~11x slower; the original
+"30,000x" figure was computed against the async-artifact sub-ms number).
 """
 
 from __future__ import annotations
@@ -23,35 +26,25 @@ import jax
 import jax.numpy as jnp
 
 from . import align_jax
-from .proposal_dense import _dense_batch
-
-
-@functools.partial(
-    jax.jit, static_argnames=("K", "want_moves", "want_stats")
+from .proposal_dense import (
+    _dense_batch,
+    dense_tables_blocked,
+    masked_weighted_sum,
 )
-def fused_step_full(
+
+# templates longer than this use the blocked dense sweep (memory-bound
+# above it, see dense_tables_blocked)
+DENSE_BLOCK_THRESHOLD = 2048
+
+
+def _fused_parts(
     template, seq, match, mismatch, ins, dels, geom, weights, K,
-    want_moves=False, want_stats=False,
+    want_moves, want_stats,
 ):
-    """One driver iteration's full device work in one dispatch.
+    """The per-read-block device work: fills, dense tables, stats.
 
-    Returns (A [N, K, T1], B [N, K, T1], moves [N, K, T1] int8 or None,
-    packed) where `packed` is ONE flat array carrying everything the host
-    needs this iteration (see pack_layout): the weighted total score,
-    per-read scores, per-read traceback error counts and the union
-    edit-indicator table (want_stats), and the dense all-edit score
-    tables. On hardware where every device->host transfer pays a fixed
-    latency (BASELINE.md), fetching one packed array instead of five is
-    the difference between a ~100 ms and a ~500 ms iteration.
-
-    `moves` is only materialized as an output when want_moves (the SCORE
-    stage's host traceback walk); bandwidth adaptation and alignment-
-    derived proposals use the device statistics instead.
-
-    The score tables are summed over reads with weight masking (psum over
-    a sharded read axis); table positions >= the true template length are
-    garbage.
-    """
+    Returns (A, B, moves_or_None, components) where components is a dict
+    of read-reduced/per-read pieces combinable across read blocks."""
     fwd = jax.vmap(
         align_jax._forward_one,
         in_axes=(None, 0, 0, 0, 0, 0, 0, None, None),
@@ -65,35 +58,150 @@ def fused_step_full(
     )
     B, _ = bwd(template, seq, match, mismatch, ins, dels, geom, K)
     A, B = jax.lax.optimization_barrier((A, B))
-    subs, insr, dele = _dense_batch(A, B, seq, match, mismatch, ins, dels, geom)
 
-    def wsum(x):
-        w = weights.reshape((-1,) + (1,) * (x.ndim - 1))
-        # mask BEFORE multiplying: 0 * -inf must not poison the total
-        return jnp.sum(jnp.where(w > 0, x, 0.0) * w, axis=0)
+    T1 = template.shape[0] + 1
+    if T1 > DENSE_BLOCK_THRESHOLD:
+        # long templates: all-columns-at-once tiles exceed HBM; compute
+        # the (already read-reduced) tables in sequential column blocks
+        sub_t, ins_t, del_t = dense_tables_blocked(
+            A, B, seq, match, mismatch, ins, dels, geom, weights
+        )
+    else:
+        subs, insr, dele = _dense_batch(
+            A, B, seq, match, mismatch, ins, dels, geom
+        )
+        sub_t = masked_weighted_sum(weights, subs)
+        ins_t = masked_weighted_sum(weights, insr)
+        del_t = masked_weighted_sum(weights, dele)
 
-    total = jnp.sum(jnp.where(weights > 0, scores, 0.0) * weights)
-    dtype = scores.dtype
-    parts = [total[None], scores]
+    comp = {
+        "total": jnp.sum(jnp.where(weights > 0, scores, 0.0) * weights),
+        "scores": scores,
+        "sub": sub_t,
+        "ins": ins_t,
+        "del": del_t,
+    }
     if want_stats:
         stats = jax.vmap(
             align_jax._traceback_stats_one, in_axes=(0, 0, None, 0, None)
         )
         nerr, edits = stats(moves, seq, template, geom, K)
-        parts.append(nerr.astype(dtype))
+        comp["n_errors"] = nerr
         # union over reads; a zero-weight padding read duplicates a real
         # read so its contribution is a no-op for the union
-        edits_any = jnp.max(edits, axis=0)
-        parts.append(edits_any.reshape(-1).astype(dtype))
-    parts += [
-        wsum(subs).reshape(-1),
-        wsum(insr).reshape(-1),
-        wsum(dele),
-    ]
-    packed = jnp.concatenate(parts)
+        comp["edits"] = jnp.max(edits, axis=0)
     if not want_moves:
         moves = None
-    return A, B, moves, packed
+    return A, B, moves, comp
+
+
+def _pack(comp, dtype, want_stats):
+    parts = [comp["total"][None].astype(dtype), comp["scores"]]
+    if want_stats:
+        parts.append(comp["n_errors"].astype(dtype))
+        parts.append(comp["edits"].reshape(-1).astype(dtype))
+    parts += [
+        comp["sub"].reshape(-1),
+        comp["ins"].reshape(-1),
+        comp["del"],
+    ]
+    return jnp.concatenate(parts)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "want_moves", "want_stats", "read_chunk")
+)
+def fused_step_full(
+    template, seq, match, mismatch, ins, dels, geom, weights, K,
+    want_moves=False, want_stats=False, read_chunk=0,
+):
+    """One driver iteration's full device work in one dispatch.
+
+    Returns (A [N, K, T1], B [N, K, T1], moves [N, K, T1] int8 or None,
+    packed) where `packed` is ONE flat array carrying everything the host
+    needs this iteration (see pack_layout): the weighted total score,
+    per-read scores, per-read traceback error counts and the union
+    edit-indicator table (want_stats), and the dense all-edit score
+    tables. Every device->host transfer pays a fixed ~100 ms round trip
+    on the tunneled TPU (BASELINE.md), so one packed fetch instead of
+    five saves ~0.4 s per iteration.
+
+    `moves` is only materialized as an output when want_moves (the SCORE
+    stage's host traceback walk); bandwidth adaptation and alignment-
+    derived proposals use the device statistics instead.
+
+    `read_chunk` > 0 runs the read axis in sequential blocks of that size
+    via lax.map (the read axis is padded to a multiple by repeating the
+    last read at weight 0), bounding peak memory: the band buffers and
+    band-layout tables are O(reads x K x T1) and at 10 kb x 512 reads the
+    all-at-once working set exceeds HBM. Chunked calls return A = B = None
+    (the dense tables make them unnecessary to the driver); moves is still
+    a full [N, K, T1] output when requested.
+
+    The score tables are summed over reads with weight masking (psum over
+    a sharded read axis); table positions >= the true template length are
+    garbage.
+    """
+    if not read_chunk or seq.shape[0] <= read_chunk:
+        A, B, moves, comp = _fused_parts(
+            template, seq, match, mismatch, ins, dels, geom, weights, K,
+            want_moves, want_stats,
+        )
+        return A, B, moves, _pack(comp, match.dtype, want_stats)
+
+    N = seq.shape[0]
+    # pad the read axis to a chunk multiple by repeating the last read at
+    # weight 0 (repetition keeps band geometry identical, so no K change)
+    n_chunks = -(-N // read_chunk)
+    Np = n_chunks * read_chunk
+    pad = Np - N
+
+    def padded(a):
+        if not pad:
+            return a
+        reps = jnp.repeat(a[-1:], pad, axis=0)
+        return jnp.concatenate([a, reps])
+
+    def blk(a):  # [N(+pad), ...] -> [n_chunks, chunk, ...]
+        a = padded(a)
+        return a.reshape((n_chunks, read_chunk) + a.shape[1:])
+
+    w_padded = jnp.concatenate(
+        [weights, jnp.zeros((pad,), weights.dtype)]
+    ) if pad else weights
+    xs = (
+        blk(seq), blk(match), blk(mismatch), blk(ins), blk(dels),
+        jax.tree_util.tree_map(blk, geom),
+        w_padded.reshape((n_chunks, read_chunk)),
+    )
+
+    def body(x):
+        seq_c, match_c, mismatch_c, ins_c, dels_c, geom_c, w_c = x
+        _, _, moves_c, comp = _fused_parts(
+            template, seq_c, match_c, mismatch_c, ins_c, dels_c, geom_c,
+            w_c, K, want_moves, want_stats,
+        )
+        if moves_c is None:
+            moves_c = jnp.zeros((0,), jnp.int8)
+        return moves_c, comp
+
+    moves_b, comps = jax.lax.map(body, xs)
+    comp = {
+        "total": jnp.sum(comps["total"]),
+        "scores": comps["scores"].reshape(Np)[:N],
+        "sub": jnp.sum(comps["sub"], axis=0),
+        "ins": jnp.sum(comps["ins"], axis=0),
+        "del": jnp.sum(comps["del"], axis=0),
+    }
+    if want_stats:
+        comp["n_errors"] = comps["n_errors"].reshape(Np)[:N]
+        # padding rows duplicate a real read, so the per-chunk unions
+        # already exclude nothing and add nothing
+        comp["edits"] = jnp.max(comps["edits"], axis=0)
+    moves = (
+        moves_b.reshape((Np,) + moves_b.shape[2:])[:N] if want_moves else None
+    )
+    return None, None, moves, _pack(comp, match.dtype, want_stats)
 
 
 def pack_layout(n_reads: int, T1: int, want_stats: bool):

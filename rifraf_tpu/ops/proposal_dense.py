@@ -36,9 +36,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.sequences import ReadBatch
+from . import align_jax
 from .align_jax import BandGeometry
 
 NEG_INF = -jnp.inf
+
+
+def masked_weighted_sum(weights, x):
+    """Sum weight*x over the leading (read) axis. Mask BEFORE multiplying:
+    a zero-weight padding row may hold -inf/nan and 0 * -inf would poison
+    the total, while a real read's legitimate -inf must propagate."""
+    w = weights.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.sum(jnp.where(w > 0, x, 0.0) * w, axis=0)
 
 
 def _dense_one_read(
@@ -57,7 +66,6 @@ def _dense_one_read(
     axis replaced by the template-position axis of the bands themselves.
     """
     K, T1 = A.shape
-    L = seq.shape[0]
     dtype = A.dtype
     slen, tlen, off = geom.slen, geom.tlen, geom.offset
     v_off = jnp.maximum(slen - tlen, 0)
@@ -70,9 +78,13 @@ def _dense_one_read(
     rmin = jnp.maximum(0, jc - off)
     rmax = jnp.minimum(jc + v_off + geom.bandwidth, slen)
 
-    # B[:, pos+1] for every pos at once
-    jnext = jnp.minimum(jnp.arange(T1, dtype=jnp.int32) + 1, tlen)
-    B_next = jnp.take(B, jnext, axis=1)  # [K, T1]
+    def shift_left(a):
+        """Column j -> column j+1's values. For B this equals the former
+        clamped take(B, min(j+1, tlen)) everywhere pos < tlen; columns at
+        or beyond tlen are garbage by contract (sliced off by callers)."""
+        return jnp.concatenate([a[:, 1:], a[:, -1:]], axis=1)
+
+    B_next = shift_left(B)  # [K, T1] = B[:, pos+1]
     neg_row = jnp.full((1, T1), NEG_INF, dtype)
     A_up = jnp.concatenate([A[1:], neg_row], axis=0)  # A[d+1, j]
     A_dn = jnp.concatenate([neg_row, A[:-1]], axis=0)  # A[d-1, j]
@@ -81,16 +93,18 @@ def _dense_one_read(
     B_next_sh = jnp.concatenate([neg_row, B_next[:-1]], axis=0)
     dele = jnp.max(A + B_next_sh, axis=0)  # [T1]; valid for pos < tlen
 
-    def edit_scores(i, m_src, d_src, B_join):
+    # band-layout table slices, shared with the fill kernel's layout:
+    # column j holds table index d + j - off - 1 (sb/mt/mm/gi) and
+    # d + j - off (dl). The insertion pass reads them directly; the
+    # substitution pass (one frame right) reads them shifted one column.
+    # Replaces full-band fancy-index gathers, measured ~1600x slower than
+    # the slice build on the available TPU (BASELINE.md round 3).
+    tabs = align_jax.band_tables(seq, match, mismatch, ins, dels, off, K, T1)
+
+    def edit_scores(i, sq, mt, mm, gi, dl, m_src, d_src, B_join):
         """Sub/ins share this: new column from (m_src, d_src) at true row
         index i[d, j], joined with B_join — for all positions and all 4
-        bases. The score-table gathers are per-table, shared by bases."""
-        si = jnp.clip(i - 1, 0, L - 1)
-        sq = seq[si]
-        mt = match[si]
-        mm = mismatch[si]
-        gi = ins[si]
-        dl = dels[jnp.clip(i, 0, L)]
+        bases. The band-layout table slices are shared by all bases."""
         valid = (i >= rmin) & (i <= rmax)
         dcand = d_src + dl
         g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
@@ -106,25 +120,152 @@ def _dense_one_read(
         return jnp.stack(outs, axis=-1)  # [T1, 4]
 
     # substitution at pos: new column in frame pos+1, joined with B[:, pos+1]
-    subs = edit_scores(d + j + 1 - off, A, A_up, B_next)
+    subs = edit_scores(
+        d + j + 1 - off, shift_left(tabs.sb), shift_left(tabs.mt),
+        shift_left(tabs.mm), shift_left(tabs.gi), shift_left(tabs.dl),
+        A, A_up, B_next,
+    )
     # insertion after pos: new column in frame pos, joined with B[:, pos]
-    insr = edit_scores(d + j - off, A_dn, A, B)
+    insr = edit_scores(
+        d + j - off, tabs.sb, tabs.mt, tabs.mm, tabs.gi, tabs.dl,
+        A_dn, A, B,
+    )
     return subs, insr, dele
 
 
 _dense_batch = jax.vmap(_dense_one_read, in_axes=(0, 0, 0, 0, 0, 0, 0, 0))
 
 
+def _hankel_rows(W, K: int, k_len: int):
+    """[K, k_len] tile from a 1-D window: tile[d, jj] = W[d + jj]."""
+    return jnp.stack([W[d : d + k_len] for d in range(K)])
+
+
+def _dense_block_one(Ab, Bb, mt_pad, mm_pad, gi_pad, dl_pad, sq_pad, geom,
+                     j0, CB: int):
+    """Score tables for CB consecutive positions of one read.
+
+    ``Ab`` is [K, CB] (columns j0..j0+CB-1), ``Bb`` is [K, CB+1] (columns
+    j0..j0+CB). Same math as _dense_one_read, restricted to the block, with
+    the per-base tables read as Hankel tiles of contiguous windows."""
+    K = Ab.shape[0]
+    dtype = Ab.dtype
+    slen, tlen, off = geom.slen, geom.tlen, geom.offset
+    v_off = jnp.maximum(slen - tlen, 0)
+
+    d = jnp.arange(K, dtype=jnp.int32)[:, None]
+    j = j0 + jnp.arange(CB, dtype=jnp.int32)[None, :]
+    jc = jnp.minimum(j + 1, tlen)
+    rmin = jnp.maximum(0, jc - off)
+    rmax = jnp.minimum(jc + v_off + geom.bandwidth, slen)
+
+    # forward-layout tiles covering table columns j0 .. j0+CB: entry
+    # [d, jj] = table[d + (j0 + jj) - off - 1] (dl: index + 1)
+    start = jnp.asarray(K + j0 - off - 1, jnp.int32)
+    k_len = CB + 1
+    W = K + k_len - 1
+    win = lambda a: jax.lax.dynamic_slice(a, (start,), (W,))
+    mt_t = _hankel_rows(win(mt_pad), K, k_len)
+    mm_t = _hankel_rows(win(mm_pad), K, k_len)
+    gi_t = _hankel_rows(win(gi_pad), K, k_len)
+    dl_t = _hankel_rows(win(dl_pad), K, k_len)
+    sb_t = _hankel_rows(win(sq_pad), K, k_len)
+
+    neg_row = jnp.full((1, CB), NEG_INF, dtype)
+    B_next = Bb[:, 1:]  # [K, CB] = B[:, j+1]
+    B_cur = Bb[:, :CB]
+    A_up = jnp.concatenate([Ab[1:], neg_row], axis=0)
+    A_dn = jnp.concatenate([neg_row, Ab[:-1]], axis=0)
+    B_next_sh = jnp.concatenate([neg_row, B_next[:-1]], axis=0)
+    dele = jnp.max(Ab + B_next_sh, axis=0)  # [CB]
+
+    def edit_scores(i, sq, mt, mm, gi, dl, m_src, d_src, B_join):
+        valid = (i >= rmin) & (i <= rmax)
+        dcand = d_src + dl
+        g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
+        G = jnp.cumsum(g, axis=0)
+        outs = []
+        for b in range(4):
+            msc = jnp.where(sq == b, mt, mm)
+            mcand = jnp.where(i >= 1, m_src + msc, NEG_INF)
+            cand = jnp.where(valid, jnp.maximum(mcand, dcand), NEG_INF)
+            NC = G + jax.lax.cummax(cand - G, axis=0)
+            NC = jnp.where(valid, NC, NEG_INF)
+            outs.append(jnp.max(NC + B_join, axis=0))
+        return jnp.stack(outs, axis=-1)  # [CB, 4]
+
+    # substitution at pos: table columns j+1 (tile columns 1..CB)
+    subs = edit_scores(
+        d + j + 1 - off, sb_t[:, 1:], mt_t[:, 1:], mm_t[:, 1:],
+        gi_t[:, 1:], dl_t[:, 1:], Ab, A_up, B_next,
+    )
+    # insertion after pos: table columns j (tile columns 0..CB-1)
+    insr = edit_scores(
+        d + j - off, sb_t[:, :CB], mt_t[:, :CB], mm_t[:, :CB],
+        gi_t[:, :CB], dl_t[:, :CB], A_dn, Ab, B_cur,
+    )
+    return subs, insr, dele
+
+
+def dense_tables_blocked(
+    A, B, seq, match, mismatch, ins, dels, geom, weights, block: int = 256
+):
+    """Weighted batch-total score tables, computed in sequential column
+    blocks (lax.map) so peak memory stays O(reads x K x block) — the
+    all-columns-at-once sweep materializes O(reads x K x T1) tiles, which
+    exceeds HBM at 10 kb x 512 reads. Returns (sub [T1, 4], ins [T1, 4],
+    del [T1]), read-reduced with zero-weight masking."""
+    N, K, T1 = A.shape
+    dtype = A.dtype
+    nblk = -(-T1 // block)
+    pad_cols = nblk * block + 1 - T1
+    negpad = jnp.full((N, K, pad_cols), NEG_INF, dtype)
+    Ap = jnp.concatenate([A, negpad], axis=-1)
+    Bp = jnp.concatenate([B, negpad], axis=-1)
+
+    # separate padded tables: stacking them [N, 4, Lp] triggers a 128x
+    # tiling expansion of the size-4 axis (see align_jax._forward_one)
+    Wpad = K + block + 1
+    mt_pad = jnp.pad(match, ((0, 0), (K, Wpad)))
+    mm_pad = jnp.pad(mismatch, ((0, 0), (K, Wpad)))
+    gi_pad = jnp.pad(ins, ((0, 0), (K, Wpad)))
+    dl_pad = jnp.pad(dels, ((0, 0), (K - 1, Wpad)))
+    sq_pad = jnp.pad(seq, ((0, 0), (K, Wpad)))
+
+    def body(j0):
+        Ab = jax.lax.dynamic_slice(
+            Ap, (jnp.int32(0), jnp.int32(0), jnp.asarray(j0, jnp.int32)),
+            (N, K, block),
+        )
+        Bb = jax.lax.dynamic_slice(
+            Bp, (jnp.int32(0), jnp.int32(0), jnp.asarray(j0, jnp.int32)),
+            (N, K, block + 1),
+        )
+        one = jax.vmap(
+            _dense_block_one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None)
+        )
+        subs, insr, dele = one(
+            Ab, Bb, mt_pad, mm_pad, gi_pad, dl_pad, sq_pad, geom, j0, block
+        )
+        return (masked_weighted_sum(weights, subs),
+                masked_weighted_sum(weights, insr),
+                masked_weighted_sum(weights, dele))
+
+    j0s = jnp.arange(nblk, dtype=jnp.int32) * block
+    sub_b, ins_b, del_b = jax.lax.map(body, j0s)
+    return (
+        sub_b.reshape(nblk * block, 4)[:T1],
+        ins_b.reshape(nblk * block, 4)[:T1],
+        del_b.reshape(nblk * block)[:T1],
+    )
+
+
 @jax.jit
 def _dense_total(A, B, seq, match, mismatch, ins, dels, geom, weights):
     subs, insr, dele = _dense_batch(A, B, seq, match, mismatch, ins, dels, geom)
-
-    def wsum(x):
-        w = weights.reshape((-1,) + (1,) * (x.ndim - 1))
-        # mask BEFORE multiplying: 0 * -inf must not poison the total
-        return jnp.sum(jnp.where(w > 0, x, 0.0) * w, axis=0)
-
-    return wsum(subs), wsum(insr), wsum(dele)
+    return (masked_weighted_sum(weights, subs),
+            masked_weighted_sum(weights, insr),
+            masked_weighted_sum(weights, dele))
 
 
 def score_all_edits(

@@ -1,0 +1,67 @@
+"""Fused-step invariants: the packed output must be identical whether the
+read axis runs all at once or in sequential memory-bounding chunks (incl.
+a chunk size that does NOT divide the read count — the padding path)."""
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax
+from rifraf_tpu.ops.fused import fused_step_full, pack_layout
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0))
+
+
+def _problem(n_reads=7, tlen=48, seed=3):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n_reads):
+        slen = int(rng.integers(tlen - 5, tlen + 6))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -0.5, size=slen)
+        reads.append(make_read_scores(s, log_p, 8, SCORES))
+    batch = batch_reads(reads, dtype=np.float64)
+    K = ((align_jax.band_height(batch, tlen) + 7) // 8) * 8
+    geom = align_jax.batch_geometry(batch, tlen)
+    t = jnp.asarray(np.pad(template, (0, 8)), jnp.int8)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, n_reads))
+    args = (t, jnp.asarray(batch.seq), jnp.asarray(batch.match),
+            jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+            jnp.asarray(batch.dels), geom, w)
+    return args, K, n_reads, t.shape[0] + 1
+
+
+@pytest.mark.parametrize("want_stats", [False, True])
+@pytest.mark.parametrize("chunk", [3, 4, 7])  # 3/4 do not divide N=7
+def test_chunked_fused_matches_unchunked(chunk, want_stats):
+    args, K, N, T1 = _problem()
+    A, B, _, packed_ref = fused_step_full(*args, K, False, want_stats)
+    assert A is not None and B is not None
+    A2, B2, _, packed_chk = fused_step_full(
+        *args, K, False, want_stats, chunk
+    )
+    if chunk < N:
+        assert A2 is None and B2 is None
+    lay = pack_layout(N, T1, want_stats)
+    ref = np.asarray(packed_ref)
+    chk = np.asarray(packed_chk)
+    assert ref.shape == chk.shape
+    for name, (a, b) in lay.items():
+        np.testing.assert_allclose(
+            chk[a:b], ref[a:b], rtol=1e-12, atol=1e-12,
+            err_msg=f"packed section {name!r} differs under chunking",
+        )
+
+
+def test_chunked_fused_moves_roundtrip():
+    """want_moves with chunking returns the full, unpadded move band."""
+    args, K, N, T1 = _problem()
+    _, _, moves_ref, _ = fused_step_full(*args, K, True, False)
+    _, _, moves_chk, _ = fused_step_full(*args, K, True, False, 3)
+    np.testing.assert_array_equal(
+        np.asarray(moves_chk), np.asarray(moves_ref)
+    )

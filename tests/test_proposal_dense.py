@@ -125,3 +125,42 @@ def test_dense_weighted_masking():
         else:
             got.append(del_p[p.pos])
     np.testing.assert_allclose(np.asarray(got), want_part, rtol=1e-12)
+
+
+def test_blocked_dense_matches_unblocked():
+    """dense_tables_blocked == the all-at-once dense sweep on identical
+    inputs (the long-template memory path must be value-identical)."""
+    import jax.numpy as jnp
+
+    from rifraf_tpu.ops.proposal_dense import (
+        _dense_batch,
+        dense_tables_blocked,
+    )
+
+    template, batch = _problem(n_reads=5, tlen=90, seed=17)
+    tlen = len(template)
+    K = align_jax.band_height(batch, tlen)
+    A, _, _, geom = align_jax.forward_batch(template, batch, tlen=tlen, K=K)
+    B, _, _ = align_jax.backward_batch(template, batch, tlen=tlen, K=K)
+    w = jnp.asarray(np.array([1.0, 0.0, 2.0, 1.0, 1.0]))  # incl. zero weight
+
+    args = (jnp.asarray(batch.seq), jnp.asarray(batch.match),
+            jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+            jnp.asarray(batch.dels))
+    subs, insr, dele = _dense_batch(A, B, *args, geom)
+
+    def wsum(x):
+        wv = np.asarray(w).reshape((-1,) + (1,) * (x.ndim - 1))
+        return np.sum(np.where(wv > 0, np.asarray(x), 0.0) * wv, axis=0)
+
+    # valid ranges: substitutions/deletions at pos < tlen, insertions at
+    # pos <= tlen; entries beyond are garbage by contract in BOTH paths
+    for block in (16, 64, 128):
+        sb, ib, db = dense_tables_blocked(A, B, *args, geom, w, block=block)
+        np.testing.assert_allclose(np.asarray(sb)[:tlen], wsum(subs)[:tlen],
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(ib)[:tlen + 1],
+                                   wsum(insr)[:tlen + 1],
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(db)[:tlen], wsum(dele)[:tlen],
+                                   rtol=1e-12, atol=1e-12)

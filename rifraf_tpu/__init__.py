@@ -55,6 +55,7 @@ from .utils.constants import (
     CODON_LENGTH,
     decode_seq,
     encode_seq,
+    reverse_complement,
 )
 from .utils.mathops import logsumexp10, summax
 from .utils.phred import (
@@ -107,6 +108,7 @@ __all__ = [
     "write_samples",
     "read_samples",
     "encode_seq",
+    "reverse_complement",
     "decode_seq",
     "BASES",
     "CODON_LENGTH",

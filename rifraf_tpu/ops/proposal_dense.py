@@ -50,6 +50,28 @@ def masked_weighted_sum(weights, x):
     return jnp.sum(jnp.where(w > 0, x, 0.0) * w, axis=0)
 
 
+def _edit_scores_core(i, sq, mt, mm, gi, dl, m_src, d_src, B_join,
+                      rmin, rmax):
+    """Shared sub/ins scoring core: new column from (m_src, d_src) at true
+    row index i, joined with B_join — for all positions in the tile and
+    all 4 bases. Used identically by the all-at-once sweep ([K, T1]
+    operands) and the blocked sweep ([K, CB] tiles); any change to the
+    recurrence must flow through here so both paths stay in lockstep."""
+    valid = (i >= rmin) & (i <= rmax)
+    dcand = d_src + dl
+    g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
+    G = jnp.cumsum(g, axis=0)
+    outs = []
+    for b in range(4):
+        msc = jnp.where(sq == b, mt, mm)
+        mcand = jnp.where(i >= 1, m_src + msc, NEG_INF)
+        cand = jnp.where(valid, jnp.maximum(mcand, dcand), NEG_INF)
+        NC = G + jax.lax.cummax(cand - G, axis=0)
+        NC = jnp.where(valid, NC, NEG_INF)
+        outs.append(jnp.max(NC + B_join, axis=0))
+    return jnp.stack(outs, axis=-1)
+
+
 def _dense_one_read(
     A,  # [K, T1] cached forward band
     B,  # [K, T1] cached backward band
@@ -102,22 +124,9 @@ def _dense_one_read(
     tabs = align_jax.band_tables(seq, match, mismatch, ins, dels, off, K, T1)
 
     def edit_scores(i, sq, mt, mm, gi, dl, m_src, d_src, B_join):
-        """Sub/ins share this: new column from (m_src, d_src) at true row
-        index i[d, j], joined with B_join — for all positions and all 4
-        bases. The band-layout table slices are shared by all bases."""
-        valid = (i >= rmin) & (i <= rmax)
-        dcand = d_src + dl
-        g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
-        G = jnp.cumsum(g, axis=0)
-        outs = []
-        for b in range(4):
-            msc = jnp.where(sq == b, mt, mm)
-            mcand = jnp.where(i >= 1, m_src + msc, NEG_INF)
-            cand = jnp.where(valid, jnp.maximum(mcand, dcand), NEG_INF)
-            NC = G + jax.lax.cummax(cand - G, axis=0)
-            NC = jnp.where(valid, NC, NEG_INF)
-            outs.append(jnp.max(NC + B_join, axis=0))
-        return jnp.stack(outs, axis=-1)  # [T1, 4]
+        return _edit_scores_core(
+            i, sq, mt, mm, gi, dl, m_src, d_src, B_join, rmin, rmax
+        )
 
     # substitution at pos: new column in frame pos+1, joined with B[:, pos+1]
     subs = edit_scores(
@@ -180,19 +189,9 @@ def _dense_block_one(Ab, Bb, mt_pad, mm_pad, gi_pad, dl_pad, sq_pad, geom,
     dele = jnp.max(Ab + B_next_sh, axis=0)  # [CB]
 
     def edit_scores(i, sq, mt, mm, gi, dl, m_src, d_src, B_join):
-        valid = (i >= rmin) & (i <= rmax)
-        dcand = d_src + dl
-        g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
-        G = jnp.cumsum(g, axis=0)
-        outs = []
-        for b in range(4):
-            msc = jnp.where(sq == b, mt, mm)
-            mcand = jnp.where(i >= 1, m_src + msc, NEG_INF)
-            cand = jnp.where(valid, jnp.maximum(mcand, dcand), NEG_INF)
-            NC = G + jax.lax.cummax(cand - G, axis=0)
-            NC = jnp.where(valid, NC, NEG_INF)
-            outs.append(jnp.max(NC + B_join, axis=0))
-        return jnp.stack(outs, axis=-1)  # [CB, 4]
+        return _edit_scores_core(
+            i, sq, mt, mm, gi, dl, m_src, d_src, B_join, rmin, rmax
+        )
 
     # substitution at pos: table columns j+1 (tile columns 1..CB)
     subs = edit_scores(

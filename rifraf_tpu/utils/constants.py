@@ -41,3 +41,11 @@ def decode_seq(codes: np.ndarray) -> str:
     if codes.size == 0:
         return ""
     return "".join(INT_TO_BASE[codes])
+
+
+def reverse_complement(codes: np.ndarray) -> np.ndarray:
+    """Reverse complement of an int8 code array. With A=0, C=1, G=2, T=3
+    the complement is simply 3 - code; padding/gap codes are preserved."""
+    codes = np.asarray(codes, dtype=np.int8)
+    out = np.where(codes >= 0, 3 - codes, codes).astype(np.int8)
+    return out[::-1].copy()

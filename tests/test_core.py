@@ -178,3 +178,16 @@ def test_batch_reads():
     np.testing.assert_allclose(batch.dels[1, :3], r2.del_scores)
     # codon scores disabled -> -inf
     assert np.all(np.isneginf(batch.cins))
+
+
+def test_reverse_complement():
+    from rifraf_tpu.utils.constants import reverse_complement
+
+    s = encode_seq("ACGTTG")
+    assert decode_seq(reverse_complement(s)) == "CAACGT"
+    # involution
+    np.testing.assert_array_equal(reverse_complement(reverse_complement(s)), s)
+    # padding codes survive untouched
+    padded = np.array([0, 1, -1, 3], dtype=np.int8)
+    out = reverse_complement(padded)
+    np.testing.assert_array_equal(out, np.array([0, -1, 2, 3], dtype=np.int8))

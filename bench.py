@@ -37,7 +37,7 @@ import numpy as np
 # CPU-backend wall time of the IDENTICAL e2e headline run on the dev host
 # (python bench.py --cpu; see BASELINE.md). Measured 2026-07-30, backend
 # verified "cpu" (the env var alone silently keeps the TPU — see --cpu).
-CPU_E2E_SECONDS = 21.53
+CPU_E2E_SECONDS = 22.82
 # CPU-backend fused-step time for --step mode (round-2 measurement).
 CPU_BASELINE_STEP_SECONDS = 1.294
 

@@ -328,6 +328,144 @@ def _backward_one(t, seq, match, mismatch, ins, dels, geom: BandGeometry, K: int
     return flipped, score
 
 
+@functools.partial(jax.jit, static_argnames=("K", "want_moves"))
+def _fwd_bwd_one(t, seq, match, mismatch, ins, dels, geom: BandGeometry,
+                 K: int, want_moves: bool = False):
+    """Forward AND backward bands in ONE column scan.
+
+    The backward band is the forward DP of the reversed problem
+    (align.jl:196-202) with identical geometry, so both chains advance
+    column-by-column in lockstep: the scan carries a [2, K] state (stream
+    0 = original, stream 1 = reversed) and every column op — candidate
+    maxes, the insert-chain cumsum/cummax — runs ONCE on the stacked
+    array instead of twice in two scans. On hardware where the fill cost
+    is per-column kernel count (BASELINE.md round 3), this roughly halves
+    the fill time. Returns (A, moves, score, B) with values identical to
+    _forward_one + _backward_one.
+
+    MAINTENANCE: the column recurrence here is the stacked-[2, K] twin of
+    _forward_one's (which additionally supports trim/skew_matches for the
+    standalone alignment APIs). Any change to the recurrence must be made
+    in BOTH; tests/test_fused.py::test_fwd_bwd_merged_matches_separate
+    pins their equivalence.
+    """
+    T = t.shape[0]
+    dtype = match.dtype
+    T1 = T + 1
+    rt = _reverse_template(t, geom.tlen)
+    rseq, rmatch, rmismatch, rins, rdels = _reverse_read(
+        seq, match, mismatch, ins, dels, geom.slen
+    )
+
+    Wpad = K + T1
+
+    def pad2(a, b, lo):
+        return jnp.stack([jnp.pad(a, (lo, Wpad)), jnp.pad(b, (lo, Wpad))])
+
+    mt_pad = pad2(match, rmatch, K)
+    mm_pad = pad2(mismatch, rmismatch, K)
+    gi_pad = pad2(ins, rins, K)
+    dl_pad = pad2(dels, rdels, K - 1)
+    sq_pad = pad2(seq, rseq, K)
+    tb_cols = jnp.stack([
+        jnp.concatenate([t[:1], t]),
+        jnp.concatenate([rt[:1], rt]),
+    ])  # [2, T1]
+
+    def read_windows(j, width):
+        start = jnp.asarray(K + j - geom.offset - 1, jnp.int32)
+        sl = lambda a: jax.lax.dynamic_slice(
+            a, (jnp.int32(0), start), (2, width)
+        )
+        return sl(sq_pad), sl(mt_pad), sl(mm_pad), sl(gi_pad), sl(dl_pad)
+
+    d = jnp.arange(K, dtype=jnp.int32)
+    neg1 = jnp.full((2, 1), NEG_INF, dtype)
+
+    def make_col(prev, j, sb, mt, mm, gi, dl, tb, first):
+        i, valid = _column_cells(geom, K, j)  # [K], shared by both streams
+        g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
+        if first:
+            cand = jnp.where(i == 0, jnp.zeros((2, K), dtype), NEG_INF)
+            mcand = dcand = jnp.full((2, K), NEG_INF, dtype)
+        else:
+            match_sc = jnp.where(sb == tb[:, None], mt, mm)
+            mcand = jnp.where(i >= 1, prev + match_sc, NEG_INF)
+            prev_up = jnp.concatenate([prev[:, 1:], neg1], axis=1)
+            dcand = prev_up + dl
+            cand = jnp.maximum(mcand, dcand)
+        G = jnp.cumsum(g, axis=1)
+        F = G + jax.lax.cummax(jnp.where(valid, cand, NEG_INF) - G, axis=1)
+        col = jnp.where(valid, F, NEG_INF)
+        if want_moves and first:
+            move = jnp.where(
+                (i > 0) & (col[0] > NEG_INF), TRACE_INSERT, TRACE_NONE
+            ).astype(jnp.int8)
+        elif want_moves:
+            # moves only for stream 0 (the true forward band)
+            shifted = jnp.concatenate(
+                [jnp.full((1,), NEG_INF, dtype), col[0, :-1]]
+            )
+            icand = shifted + g[0]
+            stacked = jnp.stack([mcand[0], icand, dcand[0]])
+            move = jnp.array(
+                [TRACE_MATCH, TRACE_INSERT, TRACE_DELETE], jnp.int8
+            )[jnp.argmax(stacked, axis=0)]
+            move = jnp.where(valid & (col[0] > NEG_INF), move, TRACE_NONE)
+        else:
+            move = jnp.zeros((K,), jnp.int8)
+        return col, move
+
+    sb0, mt0, mm0, gi0, dl0 = read_windows(jnp.int32(0), K)
+    col0, moves0 = make_col(
+        None, jnp.int32(0), sb0, mt0, mm0, gi0, dl0, tb_cols[:, 0], True,
+    )
+
+    C = _pick_unroll(T)
+
+    def step(prev, xs):
+        j, tb = xs
+        sqw, mtw, mmw, giw, dlw = read_windows(j[0], K + C - 1)
+        cols, mvs = [], []
+        for u in range(C):
+            col, move = make_col(
+                prev, j[u], sqw[:, u : u + K], mtw[:, u : u + K],
+                mmw[:, u : u + K], giw[:, u : u + K], dlw[:, u : u + K],
+                tb[:, u], False,
+            )
+            prev = col
+            cols.append(col)
+            mvs.append(move)
+        return prev, (jnp.stack(cols), jnp.stack(mvs))
+
+    xs = (
+        jnp.arange(1, T + 1, dtype=jnp.int32).reshape(T // C, C),
+        tb_cols[:, 1:].reshape(2, T // C, C).transpose(1, 0, 2),
+    )
+    _, (cols, mv) = jax.lax.scan(step, col0, xs)
+    cols = cols.reshape(T, 2, K)
+    mv = mv.reshape(T, K)
+    bands = jnp.concatenate([col0[None], cols], axis=0)  # [T1, 2, K]
+    A = bands[:, 0].T  # [K, T1]
+    moves = jnp.concatenate([moves0[None], mv], axis=0).T
+    d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
+    score = A[d_end, geom.tlen]
+
+    # backward band: flip + roll + re-mask of the reversed-stream fill
+    # (same post-processing as _backward_one)
+    rband = bands[:, 1].T
+    flipped = rband[::-1, ::-1]
+    flipped = jnp.roll(flipped, geom.nd - K, axis=0)
+    flipped = jnp.roll(flipped, geom.tlen + 1 - T1, axis=1)
+    j = jnp.arange(T1, dtype=jnp.int32)
+    i = d[:, None] + j[None, :] - geom.offset
+    valid = (i >= 0) & (i <= geom.slen) & (d[:, None] < geom.nd) & (
+        j[None, :] <= geom.tlen
+    )
+    B = jnp.where(valid, flipped, NEG_INF)
+    return A, moves, score, B
+
+
 _forward_batch = jax.jit(
     jax.vmap(_forward_one, in_axes=(None, 0, 0, 0, 0, 0, 0, None, None, None, None)),
     static_argnames=("K", "want_moves", "trim", "skew_matches"),

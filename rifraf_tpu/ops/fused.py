@@ -45,18 +45,14 @@ def _fused_parts(
 
     Returns (A, B, moves_or_None, components) where components is a dict
     of read-reduced/per-read pieces combinable across read blocks."""
-    fwd = jax.vmap(
-        align_jax._forward_one,
+    fwd_bwd = jax.vmap(
+        align_jax._fwd_bwd_one,
         in_axes=(None, 0, 0, 0, 0, 0, 0, None, None),
     )
-    bwd = jax.vmap(
-        align_jax._backward_one, in_axes=(None, 0, 0, 0, 0, 0, 0, None)
-    )
     need_moves = want_moves or want_stats
-    A, moves, scores = fwd(
+    A, moves, scores, B = fwd_bwd(
         template, seq, match, mismatch, ins, dels, geom, K, need_moves
     )
-    B, _ = bwd(template, seq, match, mismatch, ins, dels, geom, K)
     A, B = jax.lax.optimization_barrier((A, B))
 
     T1 = template.shape[0] + 1

@@ -102,20 +102,16 @@ def _consensus_step(
     pbase,
     K: int,
 ):
-    """One full sharded consensus step: batched forward + backward fills,
+    """One full sharded consensus step: the merged forward+backward fill
+    (one column scan carries both chains — align_jax._fwd_bwd_one),
     per-read total scores, and all-proposal scores, reduced over the read
     axis. The reductions are where XLA inserts `psum` over ICI when the
     read axis is sharded."""
-    fwd = jax.vmap(
-        align_jax._forward_one,
+    fwd_bwd = jax.vmap(
+        align_jax._fwd_bwd_one,
         in_axes=(None, 0, 0, 0, 0, 0, 0, None),
     )
-    bwd = jax.vmap(
-        align_jax._backward_one,
-        in_axes=(None, 0, 0, 0, 0, 0, 0, None),
-    )
-    A, _, scores = fwd(template, seq, match, mismatch, ins, dels, geom, K)
-    B, _ = bwd(template, seq, match, mismatch, ins, dels, geom, K)
+    A, _, scores, B = fwd_bwd(template, seq, match, mismatch, ins, dels, geom, K)
     score_fn = jax.vmap(
         _score_one_read, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, None)
     )

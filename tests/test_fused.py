@@ -65,3 +65,26 @@ def test_chunked_fused_moves_roundtrip():
     np.testing.assert_array_equal(
         np.asarray(moves_chk), np.asarray(moves_ref)
     )
+
+
+def test_fwd_bwd_merged_matches_separate():
+    """The single-scan fwd+bwd kernel must reproduce _forward_one and
+    _backward_one exactly (bands, moves, scores)."""
+    import jax
+
+    args, K, N, T1 = _problem(n_reads=5, tlen=53, seed=9)
+    t, seq, match, mismatch, ins, dels, geom, _ = args
+    fwd = jax.vmap(align_jax._forward_one,
+                   in_axes=(None, 0, 0, 0, 0, 0, 0, None, None))
+    bwd = jax.vmap(align_jax._backward_one,
+                   in_axes=(None, 0, 0, 0, 0, 0, 0, None))
+    A_ref, mv_ref, sc_ref = fwd(t, seq, match, mismatch, ins, dels, geom,
+                                K, True)
+    B_ref, _ = bwd(t, seq, match, mismatch, ins, dels, geom, K)
+    merged = jax.vmap(align_jax._fwd_bwd_one,
+                      in_axes=(None, 0, 0, 0, 0, 0, 0, None, None))
+    A, mv, sc, B = merged(t, seq, match, mismatch, ins, dels, geom, K, True)
+    np.testing.assert_array_equal(np.asarray(A), np.asarray(A_ref))
+    np.testing.assert_array_equal(np.asarray(B), np.asarray(B_ref))
+    np.testing.assert_array_equal(np.asarray(mv), np.asarray(mv_ref))
+    np.testing.assert_array_equal(np.asarray(sc), np.asarray(sc_ref))

@@ -172,74 +172,104 @@ def _forward_one(
     TRACE_NONE when want_moves=False.
     """
     T = t.shape[0]
-    dtype = match.dtype
     T1 = T + 1
-
-    # Stack + pad the per-base tables once; each column then reads its
-    # [K]-windows with ONE contiguous dynamic_slice (the band's row
-    # indices i = d + j - off are consecutive in d). Fancy-index gathers
-    # here measured ~1600x slower than contiguous slices (BASELINE.md
-    # round 3); materializing full [K, T1] shifted tables instead blows
-    # HBM at 10 kb x 512 reads. dl is padded one element less so the same
-    # window start yields index i for it and i-1 for the others.
     Wpad = K + T1
-    # four SEPARATE padded 1-D tables: stacking them [4, Lp] makes XLA
-    # tile the size-4 axis to its (8, 128) layout unit under vmap — a
-    # measured 128x memory expansion that OOMs the 10 kb x 512 config
-    mt_pad = jnp.pad(match, (K, Wpad))
-    mm_pad = jnp.pad(mismatch, (K, Wpad))
-    gi_pad = jnp.pad(ins, (K, Wpad))
-    dl_pad = jnp.pad(dels, (K - 1, Wpad))  # dels is [L+1]: same length
-    sq_pad = jnp.pad(seq, (K, Wpad))
-    tb_cols = jnp.concatenate([t[:1], t])  # [T1]; column j reads t[j-1]
+    bands, moves = _scan_fill(
+        jnp.pad(seq, (K, Wpad))[None],
+        jnp.pad(match, (K, Wpad))[None],
+        jnp.pad(mismatch, (K, Wpad))[None],
+        jnp.pad(ins, (K, Wpad))[None],
+        jnp.pad(dels, (K - 1, Wpad))[None],
+        jnp.concatenate([t[:1], t])[None],
+        geom, K, T, want_moves, trim,
+        0.99 if skew_matches else 1.0,
+    )
+    band = bands[:, 0].T  # [K, T+1]
+    moves = moves.T
+    d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
+    score = band[d_end, geom.tlen]
+    return band, moves, score
+
+
+def _scan_fill(sq_pad, mt_pad, mm_pad, gi_pad, dl_pad, tb_cols, geom, K, T,
+               want_moves, trim, skew_val):
+    """The shared banded column-scan fill over S stacked streams.
+
+    Every stream shares band geometry (the backward fill is the forward
+    DP of the reversed problem with IDENTICAL geometry), so one scan can
+    carry all of them as an [S, K] state — each per-column kernel
+    (candidate maxes, the insert-chain cumsum/cummax) runs once on the
+    stacked state. _forward_one passes S=1; _fwd_bwd_one passes S=2.
+
+    Per-base table reads happen as contiguous [S, window] dynamic slices
+    of the padded tables: fancy-index gathers measured ~1600x slower on
+    the available TPU (BASELINE.md round 3), and materializing full
+    [K, T1] shifted tables blows HBM at 10 kb x 512 reads. ``dl_pad`` is
+    padded one element less so the same window start yields index i for
+    it and i-1 for the others. The tables stay per-stream-stacked only
+    along S (small); stacking the four TABLE KINDS into one array makes
+    XLA tile the size-4 axis to its (8, 128) layout unit under vmap — a
+    measured 128x memory expansion.
+
+    Returns (bands [T1, S, K], moves [T1, K] int8 for stream 0).
+    """
+    S = sq_pad.shape[0]
+    dtype = mt_pad.dtype
+    skew = jnp.asarray(skew_val, dtype)
+    negS = jnp.full((S, 1), NEG_INF, dtype)
 
     def read_windows(j, width):
         start = jnp.asarray(K + j - geom.offset - 1, jnp.int32)
-        sl = lambda a: jax.lax.dynamic_slice(a, (start,), (width,))
+        sl = lambda a: jax.lax.dynamic_slice(
+            a, (jnp.int32(0), start), (S, width)
+        )
         return sl(sq_pad), sl(mt_pad), sl(mm_pad), sl(gi_pad), sl(dl_pad)
 
     def make_col(prev, j, sb, mt, mm, gi, dl, tb, first):
-        i, valid = _column_cells(geom, K, j)
+        i, valid = _column_cells(geom, K, j)  # [K], shared by all streams
         g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
         if trim:
             g = jnp.where((j == 0) | (j == geom.tlen), jnp.zeros_like(g), g)
         if first:
             # column 0: cell (0, 0) = 0; rows below filled by the chain
-            cand = jnp.where(i == 0, jnp.zeros((K,), dtype), NEG_INF)
-            mcand = dcand = jnp.full((K,), NEG_INF, dtype)
+            cand = jnp.where(i == 0, jnp.zeros((S, K), dtype), NEG_INF)
+            mcand = dcand = jnp.full((S, K), NEG_INF, dtype)
         else:
-            match_sc = jnp.where(sb == tb, mt, mm * skew)
+            match_sc = jnp.where(sb == tb[:, None], mt, mm * skew)
             # match from (i-1, j-1): same data row of the previous column
             mcand = jnp.where(i >= 1, prev + match_sc, NEG_INF)
             # delete from (i, j-1): data row d+1 of the previous column
-            prev_up = jnp.concatenate(
-                [prev[1:], jnp.full((1,), NEG_INF, dtype)]
-            )
+            prev_up = jnp.concatenate([prev[:, 1:], negS], axis=1)
             dcand = prev_up + dl
             cand = jnp.maximum(mcand, dcand)
-        col = _fill_column(cand, g, valid)
+        # within-column insert chain, closed form (see _fill_column)
+        G = jnp.cumsum(g, axis=1)
+        F = G + jax.lax.cummax(jnp.where(valid, cand, NEG_INF) - G, axis=1)
+        col = jnp.where(valid, F, NEG_INF)
         if want_moves and first:
             move = jnp.where(
-                (i > 0) & (col > NEG_INF), TRACE_INSERT, TRACE_NONE
+                (i > 0) & (col[0] > NEG_INF), TRACE_INSERT, TRACE_NONE
             ).astype(jnp.int8)
         elif want_moves:
-            shifted = jnp.concatenate([jnp.full((1,), NEG_INF, dtype), col[:-1]])
-            icand = shifted + g
+            # moves only for stream 0 (the true forward band)
+            shifted = jnp.concatenate(
+                [jnp.full((1,), NEG_INF, dtype), col[0, :-1]]
+            )
+            icand = shifted + g[0]
             # tie-break priority matches the reference helper call order:
             # match > insert > delete (align.jl:78-86)
-            stacked = jnp.stack([mcand, icand, dcand])
+            stacked = jnp.stack([mcand[0], icand, dcand[0]])
             move = jnp.array(
                 [TRACE_MATCH, TRACE_INSERT, TRACE_DELETE], jnp.int8
             )[jnp.argmax(stacked, axis=0)]
-            move = jnp.where(valid & (col > NEG_INF), move, TRACE_NONE)
+            move = jnp.where(valid & (col[0] > NEG_INF), move, TRACE_NONE)
         else:
             move = jnp.zeros((K,), jnp.int8)
         return col, move
 
-    skew = jnp.asarray(0.99 if skew_matches else 1.0, dtype)
     sb0, mt0, mm0, gi0, dl0 = read_windows(jnp.int32(0), K)
     col0, moves0 = make_col(
-        None, jnp.int32(0), sb0, mt0, mm0, gi0, dl0, tb_cols[0], True,
+        None, jnp.int32(0), sb0, mt0, mm0, gi0, dl0, tb_cols[:, 0], True,
     )
 
     # unroll C columns of straight-line elementwise code per scan step:
@@ -249,15 +279,15 @@ def _forward_one(
 
     def step(prev, xs):
         j, tb = xs
-        # consecutive columns' [K]-windows overlap: ONE [K + C - 1] slice
+        # consecutive columns' windows overlap: ONE [S, K + C - 1] slice
         # per table per block, static sub-windows per column
         sqw, mtw, mmw, giw, dlw = read_windows(j[0], K + C - 1)
         cols, mvs = [], []
         for u in range(C):
             col, move = make_col(
-                prev, j[u], sqw[u : u + K], mtw[u : u + K],
-                mmw[u : u + K], giw[u : u + K], dlw[u : u + K],
-                tb[u], False,
+                prev, j[u], sqw[:, u : u + K], mtw[:, u : u + K],
+                mmw[:, u : u + K], giw[:, u : u + K], dlw[:, u : u + K],
+                tb[:, u], False,
             )
             prev = col
             cols.append(col)
@@ -266,16 +296,14 @@ def _forward_one(
 
     xs = (
         jnp.arange(1, T + 1, dtype=jnp.int32).reshape(T // C, C),
-        tb_cols[1:].reshape(T // C, C),
+        tb_cols[:, 1:].reshape(S, T // C, C).transpose(1, 0, 2),
     )
     _, (cols, mv) = jax.lax.scan(step, col0, xs)
-    cols = cols.reshape(T, K)
+    cols = cols.reshape(T, S, K)
     mv = mv.reshape(T, K)
-    band = jnp.concatenate([col0[None, :], cols], axis=0).T  # [K, T+1]
-    moves = jnp.concatenate([moves0[None, :], mv], axis=0).T
-    d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
-    score = band[d_end, geom.tlen]
-    return band, moves, score
+    bands = jnp.concatenate([col0[None], cols], axis=0)  # [T1, S, K]
+    moves = jnp.concatenate([moves0[None], mv], axis=0)  # [T1, K]
+    return bands, moves
 
 
 def _reverse_read(seq, match, mismatch, ins, dels, slen):
@@ -331,123 +359,43 @@ def _backward_one(t, seq, match, mismatch, ins, dels, geom: BandGeometry, K: int
 @functools.partial(jax.jit, static_argnames=("K", "want_moves"))
 def _fwd_bwd_one(t, seq, match, mismatch, ins, dels, geom: BandGeometry,
                  K: int, want_moves: bool = False):
-    """Forward AND backward bands in ONE column scan.
+    """Forward AND backward bands in ONE column scan (_scan_fill, S=2).
 
     The backward band is the forward DP of the reversed problem
     (align.jl:196-202) with identical geometry, so both chains advance
-    column-by-column in lockstep: the scan carries a [2, K] state (stream
-    0 = original, stream 1 = reversed) and every column op — candidate
-    maxes, the insert-chain cumsum/cummax — runs ONCE on the stacked
-    array instead of twice in two scans. On hardware where the fill cost
-    is per-column kernel count (BASELINE.md round 3), this roughly halves
-    the fill time. Returns (A, moves, score, B) with values identical to
-    _forward_one + _backward_one.
-
-    MAINTENANCE: the column recurrence here is the stacked-[2, K] twin of
-    _forward_one's (which additionally supports trim/skew_matches for the
-    standalone alignment APIs). Any change to the recurrence must be made
-    in BOTH; tests/test_fused.py::test_fwd_bwd_merged_matches_separate
-    pins their equivalence.
+    column-by-column in lockstep and every column kernel runs once on
+    the stacked pair. On hardware where the fill cost is per-column
+    kernel count (BASELINE.md round 3), this roughly halves fill time.
+    Returns (A, moves, score, B) with values identical to
+    _forward_one + _backward_one (pinned by
+    tests/test_fused.py::test_fwd_bwd_merged_matches_separate).
     """
     T = t.shape[0]
-    dtype = match.dtype
     T1 = T + 1
     rt = _reverse_template(t, geom.tlen)
     rseq, rmatch, rmismatch, rins, rdels = _reverse_read(
         seq, match, mismatch, ins, dels, geom.slen
     )
-
     Wpad = K + T1
 
     def pad2(a, b, lo):
         return jnp.stack([jnp.pad(a, (lo, Wpad)), jnp.pad(b, (lo, Wpad))])
 
-    mt_pad = pad2(match, rmatch, K)
-    mm_pad = pad2(mismatch, rmismatch, K)
-    gi_pad = pad2(ins, rins, K)
-    dl_pad = pad2(dels, rdels, K - 1)
-    sq_pad = pad2(seq, rseq, K)
-    tb_cols = jnp.stack([
-        jnp.concatenate([t[:1], t]),
-        jnp.concatenate([rt[:1], rt]),
-    ])  # [2, T1]
-
-    def read_windows(j, width):
-        start = jnp.asarray(K + j - geom.offset - 1, jnp.int32)
-        sl = lambda a: jax.lax.dynamic_slice(
-            a, (jnp.int32(0), start), (2, width)
-        )
-        return sl(sq_pad), sl(mt_pad), sl(mm_pad), sl(gi_pad), sl(dl_pad)
-
-    d = jnp.arange(K, dtype=jnp.int32)
-    neg1 = jnp.full((2, 1), NEG_INF, dtype)
-
-    def make_col(prev, j, sb, mt, mm, gi, dl, tb, first):
-        i, valid = _column_cells(geom, K, j)  # [K], shared by both streams
-        g = jnp.where((i >= 1) & valid, gi, jnp.zeros_like(gi))
-        if first:
-            cand = jnp.where(i == 0, jnp.zeros((2, K), dtype), NEG_INF)
-            mcand = dcand = jnp.full((2, K), NEG_INF, dtype)
-        else:
-            match_sc = jnp.where(sb == tb[:, None], mt, mm)
-            mcand = jnp.where(i >= 1, prev + match_sc, NEG_INF)
-            prev_up = jnp.concatenate([prev[:, 1:], neg1], axis=1)
-            dcand = prev_up + dl
-            cand = jnp.maximum(mcand, dcand)
-        G = jnp.cumsum(g, axis=1)
-        F = G + jax.lax.cummax(jnp.where(valid, cand, NEG_INF) - G, axis=1)
-        col = jnp.where(valid, F, NEG_INF)
-        if want_moves and first:
-            move = jnp.where(
-                (i > 0) & (col[0] > NEG_INF), TRACE_INSERT, TRACE_NONE
-            ).astype(jnp.int8)
-        elif want_moves:
-            # moves only for stream 0 (the true forward band)
-            shifted = jnp.concatenate(
-                [jnp.full((1,), NEG_INF, dtype), col[0, :-1]]
-            )
-            icand = shifted + g[0]
-            stacked = jnp.stack([mcand[0], icand, dcand[0]])
-            move = jnp.array(
-                [TRACE_MATCH, TRACE_INSERT, TRACE_DELETE], jnp.int8
-            )[jnp.argmax(stacked, axis=0)]
-            move = jnp.where(valid & (col[0] > NEG_INF), move, TRACE_NONE)
-        else:
-            move = jnp.zeros((K,), jnp.int8)
-        return col, move
-
-    sb0, mt0, mm0, gi0, dl0 = read_windows(jnp.int32(0), K)
-    col0, moves0 = make_col(
-        None, jnp.int32(0), sb0, mt0, mm0, gi0, dl0, tb_cols[:, 0], True,
+    bands, moves = _scan_fill(
+        pad2(seq, rseq, K),
+        pad2(match, rmatch, K),
+        pad2(mismatch, rmismatch, K),
+        pad2(ins, rins, K),
+        pad2(dels, rdels, K - 1),
+        jnp.stack([
+            jnp.concatenate([t[:1], t]),
+            jnp.concatenate([rt[:1], rt]),
+        ]),
+        geom, K, T, want_moves, False, 1.0,
     )
-
-    C = _pick_unroll(T)
-
-    def step(prev, xs):
-        j, tb = xs
-        sqw, mtw, mmw, giw, dlw = read_windows(j[0], K + C - 1)
-        cols, mvs = [], []
-        for u in range(C):
-            col, move = make_col(
-                prev, j[u], sqw[:, u : u + K], mtw[:, u : u + K],
-                mmw[:, u : u + K], giw[:, u : u + K], dlw[:, u : u + K],
-                tb[:, u], False,
-            )
-            prev = col
-            cols.append(col)
-            mvs.append(move)
-        return prev, (jnp.stack(cols), jnp.stack(mvs))
-
-    xs = (
-        jnp.arange(1, T + 1, dtype=jnp.int32).reshape(T // C, C),
-        tb_cols[:, 1:].reshape(2, T // C, C).transpose(1, 0, 2),
-    )
-    _, (cols, mv) = jax.lax.scan(step, col0, xs)
-    cols = cols.reshape(T, 2, K)
-    mv = mv.reshape(T, K)
-    bands = jnp.concatenate([col0[None], cols], axis=0)  # [T1, 2, K]
     A = bands[:, 0].T  # [K, T1]
-    moves = jnp.concatenate([moves0[None], mv], axis=0).T
+    moves = moves.T
+    d = jnp.arange(K, dtype=jnp.int32)
     d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
     score = A[d_end, geom.tlen]
 

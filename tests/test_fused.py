@@ -67,12 +67,17 @@ def test_chunked_fused_moves_roundtrip():
     )
 
 
-def test_fwd_bwd_merged_matches_separate():
+@pytest.mark.parametrize("tlen", [
+    53,  # padded T = 61: unroll C = 1 (odd ad-hoc length)
+    56,  # padded T = 64: unroll C = 16 — the production block path
+])
+def test_fwd_bwd_merged_matches_separate(tlen):
     """The single-scan fwd+bwd kernel must reproduce _forward_one and
-    _backward_one exactly (bands, moves, scores)."""
+    _backward_one exactly (bands, moves, scores) — at both the C=1 and
+    the production C=16 unrolled-block scan paths."""
     import jax
 
-    args, K, N, T1 = _problem(n_reads=5, tlen=53, seed=9)
+    args, K, N, T1 = _problem(n_reads=5, tlen=tlen, seed=9)
     t, seq, match, mismatch, ins, dels, geom, _ = args
     fwd = jax.vmap(align_jax._forward_one,
                    in_axes=(None, 0, 0, 0, 0, 0, 0, None, None))

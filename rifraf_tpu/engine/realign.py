@@ -54,7 +54,12 @@ def _default_hbm_budget() -> float:
 
     env = os.environ.get("RIFRAF_TPU_HBM_BUDGET")
     if env:
-        return float(env)
+        budget = float(env)
+        if budget < 1:
+            raise ValueError(
+                f"RIFRAF_TPU_HBM_BUDGET must be >= 1 byte, got {env!r}"
+            )
+        return budget
     try:
         import jax
 

@@ -86,18 +86,6 @@ def _column_cells(geom: BandGeometry, K: int, j):
     return i, valid
 
 
-def _fill_column(cand, g, valid):
-    """Resolve the within-column insert chain F[d] = max(cand[d], F[d-1]+g[d]).
-
-    Closed form in the max-plus semiring: with G = cumsum(g),
-    F = G + cummax(cand - G). Valid because the in-band rows of a column are
-    contiguous in d, so no chain crosses an out-of-band gap.
-    """
-    G = jnp.cumsum(g)
-    F = G + jax.lax.cummax(cand - G)
-    return jnp.where(valid, F, NEG_INF)
-
-
 def _pick_unroll(T: int, cap: int = 16) -> int:
     """Largest power of two <= cap dividing T (template lengths are
     bucketed to multiples of 64 by the engine, so this is normally 16;
@@ -242,7 +230,10 @@ def _scan_fill(sq_pad, mt_pad, mm_pad, gi_pad, dl_pad, tb_cols, geom, K, T,
             prev_up = jnp.concatenate([prev[:, 1:], negS], axis=1)
             dcand = prev_up + dl
             cand = jnp.maximum(mcand, dcand)
-        # within-column insert chain, closed form (see _fill_column)
+        # within-column insert chain F[d] = max(cand[d], F[d-1]+g[d]),
+        # closed form in the max-plus semiring: with G = cumsum(g),
+        # F = G + cummax(cand - G). Valid because the in-band rows of a
+        # column are contiguous in d, so no chain crosses a gap.
         G = jnp.cumsum(g, axis=1)
         F = G + jax.lax.cummax(jnp.where(valid, cand, NEG_INF) - G, axis=1)
         col = jnp.where(valid, F, NEG_INF)
@@ -331,6 +322,23 @@ def _reverse_template(t, tlen):
 
 
 @functools.partial(jax.jit, static_argnames=("K",))
+def _flip_reversed_band(band, geom: BandGeometry, K: int):
+    """Map the reversed-problem forward band into backward-band layout:
+    180-degree flip, re-center the diagonal frame, re-mask rolled-in
+    padding (align.jl:196-202 flip!)."""
+    T1 = band.shape[1]
+    flipped = band[::-1, ::-1]
+    flipped = jnp.roll(flipped, geom.nd - K, axis=0)
+    flipped = jnp.roll(flipped, geom.tlen + 1 - T1, axis=1)
+    j = jnp.arange(T1, dtype=jnp.int32)
+    dd = jnp.arange(K, dtype=jnp.int32)
+    i = dd[:, None] + j[None, :] - geom.offset
+    valid = (i >= 0) & (i <= geom.slen) & (dd[:, None] < geom.nd) & (
+        j[None, :] <= geom.tlen
+    )
+    return jnp.where(valid, flipped, NEG_INF)
+
+
 def _backward_one(t, seq, match, mismatch, ins, dels, geom: BandGeometry, K: int):
     """Backward DP: forward on reversed sequences, then flip
     (align.jl:196-202)."""
@@ -341,19 +349,7 @@ def _backward_one(t, seq, match, mismatch, ins, dels, geom: BandGeometry, K: int
     band, _, score = _forward_one(
         rt, rseq, rmatch, rmismatch, rins, rdels, geom, K
     )
-    T1 = band.shape[1]
-    flipped = band[::-1, ::-1]
-    flipped = jnp.roll(flipped, geom.nd - K, axis=0)
-    flipped = jnp.roll(flipped, geom.tlen + 1 - T1, axis=1)
-    # re-mask: rolled-in padding must not look like scores
-    j = jnp.arange(T1, dtype=jnp.int32)
-    dd = jnp.arange(K, dtype=jnp.int32)
-    i = dd[:, None] + j[None, :] - geom.offset
-    valid = (i >= 0) & (i <= geom.slen) & (dd[:, None] < geom.nd) & (
-        j[None, :] <= geom.tlen
-    )
-    flipped = jnp.where(valid, flipped, NEG_INF)
-    return flipped, score
+    return _flip_reversed_band(band, geom, K), score
 
 
 @functools.partial(jax.jit, static_argnames=("K", "want_moves"))
@@ -395,22 +391,11 @@ def _fwd_bwd_one(t, seq, match, mismatch, ins, dels, geom: BandGeometry,
     )
     A = bands[:, 0].T  # [K, T1]
     moves = moves.T
-    d = jnp.arange(K, dtype=jnp.int32)
     d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
     score = A[d_end, geom.tlen]
 
-    # backward band: flip + roll + re-mask of the reversed-stream fill
-    # (same post-processing as _backward_one)
-    rband = bands[:, 1].T
-    flipped = rband[::-1, ::-1]
-    flipped = jnp.roll(flipped, geom.nd - K, axis=0)
-    flipped = jnp.roll(flipped, geom.tlen + 1 - T1, axis=1)
-    j = jnp.arange(T1, dtype=jnp.int32)
-    i = d[:, None] + j[None, :] - geom.offset
-    valid = (i >= 0) & (i <= geom.slen) & (d[:, None] < geom.nd) & (
-        j[None, :] <= geom.tlen
-    )
-    B = jnp.where(valid, flipped, NEG_INF)
+    # backward band: the reversed-stream fill in backward layout
+    B = _flip_reversed_band(bands[:, 1].T, geom, K)
     return A, moves, score, B
 
 
@@ -506,7 +491,7 @@ def _resolve_insert_chain(seed, ichain):
     whose move is INSERT extends the path to row d-1, so membership
     propagates DOWNWARD in d from every seed through runs of insert moves:
     P[d-1] |= P[d] & ichain[d]. Solved in closed form with the same
-    max-plus cumulative trick as the fill's insert chain (_fill_column),
+    max-plus cumulative trick as the fill's insert chain (_scan_fill),
     on the flipped axis and with finite sentinels (bool semiring embedded
     as 0 / -1e6; path lengths <= K keep everything far from overflow)."""
     s = seed[::-1]

@@ -89,3 +89,17 @@ def test_cli_jobs_matches_sequential(tmp_path):
     got_par = [decode_seq(s) for s in read_fasta(out_par)]
     assert got_seq == got_par
     assert len(got_seq) == 3
+
+
+def test_sweep_propagates_job_failure():
+    """A failing job fails the whole sweep (the reference re-throws
+    RemoteException from workers, scripts/rifraf.jl:204-207)."""
+    import pytest
+
+    def job(x):
+        if x == 2:
+            raise ValueError("boom")
+        return x
+
+    with pytest.raises(ValueError, match="boom"):
+        sweep_clusters(job, [1, 2, 3], max_workers=3)

@@ -93,3 +93,29 @@ def test_fwd_bwd_merged_matches_separate(tlen):
     np.testing.assert_array_equal(np.asarray(B), np.asarray(B_ref))
     np.testing.assert_array_equal(np.asarray(mv), np.asarray(mv_ref))
     np.testing.assert_array_equal(np.asarray(sc), np.asarray(sc_ref))
+
+
+def test_driver_equal_under_forced_chunking(monkeypatch):
+    """rifraf() must produce the identical consensus when the fused step
+    is forced to run the read axis in sequential chunks (the big-problem
+    memory path, exercised here at small scale via a tiny budget)."""
+    from rifraf_tpu.engine import realign
+    from rifraf_tpu.engine.driver import rifraf
+    from rifraf_tpu.engine.params import RifrafParams
+    from rifraf_tpu.models.errormodel import ErrorModel
+    from rifraf_tpu.sim.sample import sample_sequences
+
+    rng = np.random.default_rng(23)
+    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=7, length=60, error_rate=0.02, rng=rng,
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    params = RifrafParams(batch_size=0, batch_fixed=False)
+    base = rifraf(seqs, phreds=phreds, params=params)
+
+    # monkeypatch teardown restores the pre-test value afterwards
+    monkeypatch.setattr(realign, "FUSED_HBM_BUDGET", 1.0)  # force chunks
+    chunked = rifraf(seqs, phreds=phreds, params=params)
+
+    np.testing.assert_array_equal(base.consensus, chunked.consensus)
+    assert base.state.converged == chunked.state.converged

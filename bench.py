@@ -35,9 +35,11 @@ import time
 import numpy as np
 
 # CPU-backend wall time of the IDENTICAL e2e headline run on the dev host
-# (python bench.py --cpu; see BASELINE.md). Measured 2026-07-30, backend
-# verified "cpu" (the env var alone silently keeps the TPU — see --cpu).
+# (python bench.py --cpu; see BASELINE.md). Backend verified "cpu" (the
+# env var alone silently keeps the TPU — see --cpu). The date/commit ride
+# along in the JSON so a stale baseline is detectable.
 CPU_E2E_SECONDS = 22.82
+CPU_BASELINE_META = {"date": "2026-07-30", "commit": "e61b598"}
 # CPU-backend fused-step time for --step mode (round-2 measurement).
 CPU_BASELINE_STEP_SECONDS = 1.294
 
@@ -234,6 +236,7 @@ def main():
         "value": round(wall, 3),
         "unit": "s",
         "vs_baseline": round(CPU_E2E_SECONDS / wall, 2),
+        "baseline_measured": CPU_BASELINE_META,
         "iterations": n_iters,
         "template_recovered": recovered,
         "backend": jax.default_backend(),

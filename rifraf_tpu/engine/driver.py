@@ -470,9 +470,13 @@ def estimate_probs(state: RifrafState, params: RifrafParams) -> EstimatedProbs:
     """Per-base quality estimation: score every edit everywhere
     (model.jl:737-791)."""
     tlen = len(state.consensus)
+    # all three tables start at the no-change score: a slot no proposal
+    # covers must behave as "no edit" (= state.score), not 0.0 — a
+    # positive 0.0 slot would trip the positivity check below if proposal
+    # gating ever stops covering every insertion position
     sub_scores = np.zeros((tlen, 4)) + state.score
     del_scores = np.zeros(tlen) + state.score
-    ins_scores = np.zeros((tlen + 1, 4))
+    ins_scores = np.zeros((tlen + 1, 4)) + state.score
 
     uref = (
         state.reference is not None

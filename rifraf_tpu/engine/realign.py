@@ -63,7 +63,14 @@ def _default_hbm_budget() -> float:
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats()
+        # prefer the thread-local default device (cluster-sweep workers
+        # pin themselves with jax.default_device) over device 0; the
+        # config value may also be a platform STRING ("tpu"), which has
+        # no memory_stats — fall back to device 0 then
+        dev = getattr(jax.config, "jax_default_device", None)
+        if dev is None or not hasattr(dev, "memory_stats"):
+            dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
         if stats and stats.get("bytes_limit"):
             return 0.75 * float(stats["bytes_limit"])
     except Exception:
@@ -71,24 +78,18 @@ def _default_hbm_budget() -> float:
     return 12e9
 
 
-FUSED_HBM_BUDGET = None  # resolved lazily on first use (_pick_read_chunk)
-
-
 def _bucket(n: int, b: int) -> int:
     return ((n + b - 1) // b) * b
 
 
-def _pick_read_chunk(n: int, K: int, T1: int) -> int:
+def _pick_read_chunk(n: int, K: int, T1: int, budget: float) -> int:
     """Chunk size whose fused working set fits the budget (ceil division
     over the fewest chunks — ops.fused pads the read axis to a multiple);
     0 = no chunking needed."""
-    global FUSED_HBM_BUDGET
-    if FUSED_HBM_BUDGET is None:
-        FUSED_HBM_BUDGET = _default_hbm_budget()
     per_read = K * T1 * _BYTES_PER_CELL
-    if n * per_read <= FUSED_HBM_BUDGET:
+    if n * per_read <= budget:
         return 0
-    n_chunks = -(-(n * per_read) // int(FUSED_HBM_BUDGET))
+    n_chunks = -(-(n * per_read) // int(budget))
     return max(1, -(-n // n_chunks))
 
 
@@ -114,6 +115,10 @@ class BatchAligner:
         self.len_bucket = int(len_bucket)
         self.mesh = mesh
         self.backend = backend
+        # resolved per aligner, not as a process global: cluster-sweep
+        # threads pinned to different (possibly heterogeneous) devices
+        # must each chunk against their OWN device's HBM
+        self.hbm_budget = _default_hbm_budget()
         validate_backend(backend, self.dtype, mesh)
         self.n_forward_fills = 0  # diagnostic: counts device forward launches
         self.timers = Timers()
@@ -243,7 +248,12 @@ class BatchAligner:
         # round trip (BASELINE.md "tunneled TPU" measurements) — this is
         # the realign_As/realign_Bs dirty-flag fast path of model.jl:
         # 689-703, keyed on content instead of flags.
-        key = (t.tobytes(), tlen, want_moves, want_stats)
+        # bandwidths are part of the key: a hit must never serve bands
+        # filled under different bandwidths. The cached key holds the
+        # POST-adaptation bandwidths of the fill that produced the bands,
+        # so a hit requires the current bandwidths to match those.
+        key = (t.tobytes(), tlen, want_moves, want_stats,
+               self.bandwidths.tobytes())
         if key == self._realign_key and bool(self.fixed.all()):
             return
         self._tlen = tlen
@@ -269,7 +279,8 @@ class BatchAligner:
             # under a mesh (the read axis is already sharded across chips)
             chunk = (
                 0 if self.mesh is not None
-                else _pick_read_chunk(self.batch.n_reads, K, T1)
+                else _pick_read_chunk(self.batch.n_reads, K, T1,
+                                      self.hbm_budget)
             )
             with self.timers.time("fused_dispatch"):
                 A, B, moves, packed = fused_step_full(
@@ -323,7 +334,10 @@ class BatchAligner:
             if not grew:
                 self.fixed[:] = True
                 break
-        self._realign_key = key
+        # store with the FINAL bandwidths (adaptation may have doubled
+        # them above); the entry-time `key` would never hit again
+        self._realign_key = (t.tobytes(), tlen, want_moves, want_stats,
+                             self.bandwidths.tobytes())
 
     def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float,
                               entry_bw: np.ndarray) -> bool:

@@ -169,7 +169,14 @@ def _dense_block_one(Ab, Bb, mt_pad, mm_pad, gi_pad, dl_pad, sq_pad, geom,
     rmax = jnp.minimum(jc + v_off + geom.bandwidth, slen)
 
     # forward-layout tiles covering table columns j0 .. j0+CB: entry
-    # [d, jj] = table[d + (j0 + jj) - off - 1] (dl: index + 1)
+    # [d, jj] = table[d + (j0 + jj) - off - 1] (dl: index + 1).
+    # INVARIANT (clamp-is-masked): when the template is much longer than
+    # a read, `start` can exceed the padded table length and XLA clamps
+    # the slice start, silently shifting the window. That is safe only
+    # because every cell the shifted window feeds has true row index
+    # i > slen there, i.e. lies outside [rmin, rmax], and
+    # _edit_scores_core masks it to -inf. Any change to the valid mask
+    # must preserve this.
     start = jnp.asarray(K + j0 - off - 1, jnp.int32)
     k_len = CB + 1
     W = K + k_len - 1

@@ -95,7 +95,15 @@ def sweep_clusters(
 
     with ThreadPoolExecutor(max_workers=max_workers) as pool:
         futures = [pool.submit(run, j) for j in jobs]
-        return [f.result() for f in futures]
+        try:
+            return [f.result() for f in futures]
+        except BaseException:
+            # first failure: stop handing out queued jobs — a long sweep
+            # must not keep burning device time after a fatal error
+            # (already-running jobs finish; cancel() only stops pending)
+            for f in futures:
+                f.cancel()
+            raise
 
 
 def resolve_jobs_flag(jobs_flag: int, n_files: int) -> int:

@@ -18,6 +18,11 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 # persistent compilation cache: the engine's bucketed shapes mean a small,
-# stable set of executables — reuse them across test runs
-jax.config.update("jax_compilation_cache_dir", "/tmp/rifraf_jax_cache")
+# stable set of executables — reuse them across test runs. Overridable so
+# concurrent pytest processes can use private caches (the jax cache
+# serializer has segfaulted under concurrent writers on this image).
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("RIFRAF_TPU_CACHE", "/tmp/rifraf_jax_cache"),
+)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

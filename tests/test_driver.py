@@ -5,6 +5,8 @@ reads must recover the exact template across a parameter grid, plus unit
 coverage for proposal generation, stage logic, and quality estimation.
 """
 
+import itertools
+
 import numpy as np
 import pytest
 
@@ -75,49 +77,71 @@ SAMPLE_PARAMS = dict(
 )
 
 
-@pytest.mark.parametrize("use_ref", [False, True])
-@pytest.mark.parametrize("do_alignment_proposals", [False, True])
-def test_full_model_recovers_template(use_ref, do_alignment_proposals):
-    """Template recovery on simulated reads (test_model.jl:325-375)."""
-    rng = np.random.default_rng(1234)
-    n_recovered = 0
-    n_runs = 3
-    for trial in range(n_runs):
-        (ref, template, t_p, seqs, actual, phreds, cb, db) = sample_sequences(
-            nseqs=5, length=30, rng=rng, **SAMPLE_PARAMS
-        )
-        params = RifrafParams(
-            scores=SEQ_SCORES,
-            ref_scores=REF_SCORES,
-            do_alignment_proposals=do_alignment_proposals,
-            batch_size=6,
-            seed=trial,
-        )
-        result = rifraf(
-            seqs,
-            phreds=phreds,
-            reference=ref if use_ref else None,
-            params=params,
-        )
-        if decode_seq(result.consensus) == decode_seq(template):
-            n_recovered += 1
-    # the reference admits this is stochastic (test_model.jl:326); require
-    # a majority of trials to recover the exact template
-    assert n_recovered >= 2, f"only {n_recovered}/{n_runs} recovered"
+# the reference's full 2^4 x 2 = 32-combo integration grid
+# (test_model.jl:346-372): every combination of use_ref x
+# do_alignment_proposals x seed_indels x indel_correction_only x
+# batch_size must recover the exact template. The reference samples
+# fresh data per combo from one seeded stream and admits stochasticity
+# (test_model.jl:326); here each combo gets its own deterministic seed
+# (1234 + index) under which ALL 32 recover exactly (verified by sweep).
+_GRID = [
+    (i, *combo)
+    for i, combo in enumerate(itertools.product(
+        (True, False),  # use_ref
+        (True, False),  # do_alignment_proposals
+        (True, False),  # seed_indels
+        (True, False),  # indel_correction_only
+        (3, 6),  # batch_size
+    ))
+]
+
+
+@pytest.mark.parametrize(
+    "idx,use_ref,do_alignment_proposals,seed_indels,indel_correction_only,batch_size",
+    _GRID,
+)
+def test_full_model_recovers_template(
+    idx, use_ref, do_alignment_proposals, seed_indels,
+    indel_correction_only, batch_size,
+):
+    """Exact template recovery across the reference's full parameter grid
+    (test_model.jl:325-375)."""
+    rng = np.random.default_rng(1234 + idx)
+    (ref, template, t_p, seqs, actual, phreds, cb, db) = sample_sequences(
+        nseqs=5, length=30, rng=rng, **SAMPLE_PARAMS
+    )
+    params = RifrafParams(
+        scores=SEQ_SCORES,
+        ref_scores=REF_SCORES,
+        do_alignment_proposals=do_alignment_proposals,
+        seed_indels=seed_indels,
+        indel_correction_only=indel_correction_only,
+        batch_size=batch_size,
+        seed=1234 + idx,
+    )
+    result = rifraf(
+        seqs,
+        phreds=phreds,
+        reference=ref if use_ref else None,
+        params=params,
+    )
+    assert decode_seq(result.consensus) == decode_seq(template)
 
 
 def test_frame_correction_fixes_frameshift():
     """FRAME stage must repair single-base frameshifts using the
-    reference (the core RIFRAF feature)."""
+    reference (the core RIFRAF feature): after convergence the
+    consensus-vs-reference alignment must contain NO single (non-codon)
+    indels (the FRAME exit criterion, model.jl:532-536, 963-965) — a
+    run that fixed nothing cannot pass."""
     rng = np.random.default_rng(7)
     (ref, template, t_p, seqs, actual, phreds, cb, db) = sample_sequences(
         nseqs=6, length=30, error_rate=0.08, rng=rng
     )
     result = rifraf(seqs, phreds=phreds, reference=ref, params=RifrafParams(seed=1))
     assert result.state.converged
-    # frame-corrected consensus must have no single indels vs reference
-    final_len = len(result.consensus)
-    assert abs(final_len - len(template)) <= 3
+    assert result.state.reference is not None
+    assert not has_single_indels(result.consensus, result.state.reference)
 
 
 def test_do_score_quality_estimation():
@@ -142,17 +166,20 @@ def test_do_score_quality_estimation():
     assert result.aln_error_probs.shape == (L,)
 
 
-def test_correct_shifts_golden_cases():
-    """test_correct_shifts.jl golden in/out cases."""
-    # single deletion in consensus restored from reference
-    ref = "AAACCCGGGTTT"
-    cases = [
-        ("AAACCCGGGTTT", "AAACCCGGGTTT"),  # already fine
-        ("AAACCGGGTTT", "AAACCGGGGTTT"),  # 11 bases: one insertion needed
-    ]
-    for consensus, want_len_like in cases:
-        got = correct_shifts(consensus, ref)
-        assert len(got) % 3 == 0
+@pytest.mark.parametrize(
+    "consensus,reference,expected",
+    [
+        # the reference's exact golden in/out cases
+        # (/root/reference/test/test_correct_shifts.jl:8-35)
+        ("TTTT", "TTT", "TTT"),  # one deletion
+        ("TT", "TTT", "TTT"),  # one insertion
+        ("TTTACCC", "TTTCGC", "TTTCCC"),  # deletion inside
+        ("TTTAAACCC", "TTTCGC", "TTTAAACCC"),  # codon indel: unchanged
+    ],
+)
+def test_correct_shifts_golden_cases(consensus, reference, expected):
+    got = correct_shifts(consensus, reference)
+    assert decode_seq(got) == expected
 
 
 def test_calibrate_phreds():

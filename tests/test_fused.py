@@ -113,8 +113,9 @@ def test_driver_equal_under_forced_chunking(monkeypatch):
     params = RifrafParams(batch_size=0, batch_fixed=False)
     base = rifraf(seqs, phreds=phreds, params=params)
 
-    # monkeypatch teardown restores the pre-test value afterwards
-    monkeypatch.setattr(realign, "FUSED_HBM_BUDGET", 1.0)  # force chunks
+    # the budget resolves per BatchAligner from the env override
+    # (engine.realign._default_hbm_budget); teardown restores the env
+    monkeypatch.setenv("RIFRAF_TPU_HBM_BUDGET", "1")  # force chunks
     chunked = rifraf(seqs, phreds=phreds, params=params)
 
     np.testing.assert_array_equal(base.consensus, chunked.consensus)

@@ -121,7 +121,8 @@ def test_consensus_cli_recovers_templates(tmp_path):
 def test_shifts_cli(tmp_path):
     infile = str(tmp_path / "in.fasta")
     outfile = str(tmp_path / "out.fasta")
-    # reference first, then sequences sharing it
+    # reference first, then sequences sharing it; "broken" drops one C
+    # of the CCC codon, so frame correction must re-insert exactly it
     write_fasta(
         infile,
         [encode_seq("AAACCCGGGTTT"), encode_seq("AAACCGGGTTT")],
@@ -131,4 +132,4 @@ def test_shifts_cli(tmp_path):
     assert rc == 0
     got = read_fasta(outfile)
     assert len(got) == 1
-    assert len(got[0]) % 3 == 0
+    assert decode_seq(got[0]) == "AAACCCGGGTTT"

@@ -1,0 +1,166 @@
+"""Shake out ops.fill_pallas against the XLA oracle.
+
+CPU interpret mode by default; pass --tpu to run the real kernel.
+"""
+
+import os
+import sys
+import time
+
+interpret = "--tpu" not in sys.argv
+
+if interpret:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+if interpret:
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, "/root/repo")
+
+import jax.numpy as jnp
+import numpy as np
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax, fill_pallas
+
+TLEN = int(os.environ.get("TLEN", "40"))
+N_READS = int(os.environ.get("NREADS", "5"))
+BW = int(os.environ.get("BW", "6"))
+
+scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+rng = np.random.default_rng(3)
+template = rng.integers(0, 4, size=TLEN).astype(np.int8)
+reads = []
+for n in range(N_READS):
+    slen = int(rng.integers(max(4, TLEN - 6), TLEN + 7))
+    s = rng.integers(0, 4, size=slen).astype(np.int8)
+    log_p = rng.uniform(-3.0, -1.0, size=slen)
+    reads.append(make_read_scores(s, log_p, BW, scores))
+batch = batch_reads(reads, dtype=np.float32)
+
+tlen = TLEN
+geom = align_jax.batch_geometry(batch, tlen)
+off_h = np.asarray(geom.offset)
+nd_h = np.asarray(geom.nd)
+K = fill_pallas.uniform_band_height(off_h, nd_h)
+Tmax = ((tlen + 63) // 64) * 64
+T1p = Tmax + 64
+
+tpl_pad = np.zeros(Tmax, np.int8)
+tpl_pad[:tlen] = template
+
+Npad = ((batch.n_reads + 127) // 128) * 128
+bufs = fill_pallas.build_fill_buffers(
+    jnp.asarray(batch.seq), jnp.asarray(batch.match),
+    jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+    jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
+)
+
+t0 = time.perf_counter()
+A, Brev, sc, OFF = fill_pallas.fill_uniform(
+    jnp.asarray(tpl_pad), jnp.int32(tlen), bufs, geom, K, T1p,
+    interpret=interpret,
+)
+jax.block_until_ready(A)
+print(f"fill_uniform: {time.perf_counter() - t0:.1f}s (compile+run) "
+      f"K={K} T1p={T1p} Npad={Npad}", flush=True)
+
+# oracle: XLA per-read-frame fill
+Kx = align_jax.band_height(batch, tlen)
+bands_x, _, scores_x, _ = align_jax.forward_batch(tpl_pad, batch, tlen=tlen, K=Kx)
+bands_x = np.asarray(bands_x)
+scores_x = np.asarray(scores_x)
+
+A = np.asarray(A)[: batch.n_reads]
+sc = np.asarray(sc)[: batch.n_reads]
+OFF = int(OFF)
+
+ok = True
+for k in range(batch.n_reads):
+    delta = OFF - int(off_h[k])
+    ndk = int(nd_h[k])
+    # uniform-frame rows [delta, delta+nd) == per-read rows [0, nd)
+    got = A[k, delta : delta + ndk, : tlen + 1]
+    want = bands_x[k, :ndk, : tlen + 1]
+    finite = np.isfinite(want)
+    if not np.allclose(got[finite], want[finite], rtol=1e-5, atol=1e-5):
+        bad = np.argwhere(
+            ~np.isclose(got, want, rtol=1e-5, atol=1e-5) & finite
+        )
+        print(f"read {k}: band mismatch at {bad[:5]} "
+              f"got={got[tuple(bad[0])]} want={want[tuple(bad[0])]}")
+        ok = False
+    # out-of-band cells must be <= sentinel-ish (never look like scores)
+    if np.any(got[~finite] > -1e30):
+        print(f"read {k}: out-of-band cell not masked")
+        ok = False
+
+print("forward bands match:", ok)
+print("forward scores match:",
+      np.allclose(sc, scores_x, rtol=1e-5, atol=1e-5), flush=True)
+
+# backward oracle
+Bx, scores_b, _ = align_jax.backward_batch(tpl_pad, batch, tlen=tlen, K=Kx)
+Bx = np.asarray(Bx)
+B = fill_pallas.flip_reversed_uniform(
+    Brev, jnp.int32(tlen), bufs.lengths, OFF, K
+)
+B = np.asarray(B)[: batch.n_reads]
+
+okb = True
+for k in range(batch.n_reads):
+    delta = OFF - int(off_h[k])
+    ndk = int(nd_h[k])
+    got = B[k, delta : delta + ndk, : tlen + 1]
+    want = Bx[k, :ndk, : tlen + 1]
+    finite = np.isfinite(want)
+    if not np.allclose(got[finite], want[finite], rtol=1e-5, atol=1e-5):
+        bad = np.argwhere(~np.isclose(got, want, rtol=1e-5, atol=1e-5) & finite)
+        print(f"read {k}: BACKWARD mismatch at {bad[:5]} "
+              f"got={got[tuple(bad[0])]} want={want[tuple(bad[0])]}")
+        okb = False
+
+print("backward bands match:", okb, flush=True)
+
+if "--time" in sys.argv:
+    tpl_dev = jnp.asarray(tpl_pad)
+    jax.block_until_ready(bufs)
+    best = np.inf
+    for i in range(6):
+        t0 = time.perf_counter()
+        A2, Brev2, sc2, OFF2 = fill_pallas.fill_uniform(
+            tpl_dev, jnp.int32(tlen), bufs, geom, K, T1p, interpret=interpret
+        )
+        B2 = fill_pallas.flip_reversed_uniform(
+            Brev2, jnp.int32(tlen), bufs.lengths, OFF2, K
+        )
+        jax.block_until_ready((A2, B2, sc2))
+        dt = time.perf_counter() - t0
+        if i:
+            best = min(best, dt)
+        print(f"  warm fill+flip: {dt*1e3:.1f} ms", flush=True)
+    print(f"pallas fill+flip best: {best*1e3:.1f} ms", flush=True)
+
+    # XLA merged fill for comparison (same process, same data)
+    fwd_bwd = jax.jit(jax.vmap(
+        align_jax._fwd_bwd_one, in_axes=(None, 0, 0, 0, 0, 0, 0, None, None)
+    ), static_argnames=("K", "want_moves"))
+    args = (jnp.asarray(np.pad(tpl_pad, (0, 0))), jnp.asarray(batch.seq),
+            jnp.asarray(batch.match), jnp.asarray(batch.mismatch),
+            jnp.asarray(batch.ins), jnp.asarray(batch.dels))
+    jax.block_until_ready(args)
+    bestx = np.inf
+    for i in range(4):
+        t0 = time.perf_counter()
+        out = fwd_bwd(*args, geom, Kx, False)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        if i:
+            bestx = min(bestx, dt)
+    print(f"xla merged fill best: {bestx*1e3:.1f} ms "
+          f"(speedup {bestx/best:.1f}x)", flush=True)
+
+sys.exit(0 if (ok and okb) else 1)

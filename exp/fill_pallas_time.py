@@ -1,0 +1,130 @@
+"""Stage-by-stage timing of the Pallas fill path on TPU.
+
+Times (warm, device-resident args, block per call):
+  1. _fill_call alone (kernel + out reshape nothing else)
+  2. buffer build (place + block tables)
+  3. fill_uniform end-to-end
+  4. + flip_reversed_uniform
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax, fill_pallas
+
+TLEN = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+N_READS = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+BW = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+
+scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+rng = np.random.default_rng(3)
+template = rng.integers(0, 4, size=TLEN).astype(np.int8)
+reads = []
+for n in range(N_READS):
+    slen = int(rng.integers(TLEN - 8, TLEN + 9))
+    s = rng.integers(0, 4, size=slen).astype(np.int8)
+    log_p = rng.uniform(-3.0, -1.0, size=slen)
+    reads.append(make_read_scores(s, log_p, BW, scores))
+batch = batch_reads(reads, dtype=np.float32)
+
+tlen = TLEN
+geom = align_jax.batch_geometry(batch, tlen)
+K = fill_pallas.uniform_band_height(np.asarray(geom.offset), np.asarray(geom.nd))
+Tmax = ((tlen + 63) // 64) * 64
+T1p = Tmax + 64
+tpl_pad = np.zeros(Tmax, np.int8)
+tpl_pad[:tlen] = template
+Npad = ((batch.n_reads + 127) // 128) * 128
+
+bufs = fill_pallas.build_fill_buffers(
+    jnp.asarray(batch.seq), jnp.asarray(batch.match),
+    jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+    jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
+)
+jax.block_until_ready(bufs)
+C = fill_pallas._pick_cols(T1p, K)
+print(f"K={K} T1p={T1p} C={C} Npad={Npad} backend={jax.default_backend()}",
+      flush=True)
+
+
+def timeit(label, f, n=5):
+    jax.block_until_ready(f())
+    best = np.inf
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f())
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:28s} {best*1e3:9.2f} ms", flush=True)
+    return best
+
+
+tpl_dev = jnp.asarray(tpl_pad)
+tl = jnp.int32(tlen)
+
+# stage 2: buffer build only (jit the prep portion)
+@jax.jit
+def prep_only(template, tlen):
+    # replicate fill_uniform's prep: places + blocking for both streams
+    OFF = jnp.max(geom.offset).astype(jnp.int32)
+    n_steps = T1p // C
+    CB = C + K
+    L = bufs.seq_T.shape[0]
+    Lbuf = T1p + K + 8
+    Lbig = Lbuf + L
+
+    def place(tab_T, row0, fill):
+        buf = jnp.full((Lbig, Npad), fill, tab_T.dtype)
+        buf = jax.lax.dynamic_update_slice(
+            buf, tab_T, (row0.astype(jnp.int32), jnp.int32(0)))
+        return buf[:Lbuf]
+
+    def stream(sqT, mtT, mmT, giT, dlT):
+        return [
+            fill_pallas._block_tables(place(x, OFF + 1, 0.0), n_steps, C, CB)
+            for x in (mtT, mmT, giT)
+        ] + [
+            fill_pallas._block_tables(place(dlT, OFF, 0.0), n_steps, C, CB),
+            fill_pallas._block_tables(place(sqT, OFF + 1, -9), n_steps, C, CB),
+        ]
+
+    a = stream(bufs.seq_T, bufs.match_T, bufs.mismatch_T, bufs.ins_T, bufs.dels_T)
+    b = stream(bufs.rseq_T, bufs.rmatch_T, bufs.rmismatch_T, bufs.rins_T, bufs.rdels_T)
+    return a + b
+
+
+timeit("prep(build+block tables)", lambda: prep_only(tpl_dev, tl))
+
+# full fill_uniform without flip
+def fill_only():
+    A, Brev, sc, OFF = fill_pallas.fill_uniform(
+        tpl_dev, tl, bufs, geom, K, T1p)
+    return A, Brev, sc
+
+timeit("fill_uniform (A,Brev,sc)", fill_only)
+
+def fill_flip():
+    A, Brev, sc, OFF = fill_pallas.fill_uniform(
+        tpl_dev, tl, bufs, geom, K, T1p)
+    B = fill_pallas.flip_reversed_uniform(Brev, tl, bufs.lengths, OFF, K)
+    return A, B, sc
+
+timeit("fill_uniform + flip", fill_flip)
+
+# scores only (skip the big band outputs' materialization cost? they are
+# pallas outputs regardless; this just skips the reshape/transpose)
+@jax.jit
+def scores_only(template, tlen):
+    A, Brev, sc, OFF = fill_pallas.fill_uniform(
+        template, tlen, bufs, geom, K, T1p)
+    return sc
+
+timeit("fill (scores fetch only)", lambda: scores_only(tpl_dev, tl))

@@ -1,0 +1,72 @@
+"""Scan the reference's 2^4 x 2 integration grid (test_model.jl:325-375)
+for exact template recovery; report failures per combo/seed."""
+
+import itertools
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/rifraf_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+from rifraf_tpu.engine.driver import rifraf
+from rifraf_tpu.engine.params import RifrafParams
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.sim.sample import sample_sequences
+from rifraf_tpu.utils.constants import decode_seq
+
+REF_SAMPLE_ERRORS = ErrorModel(8.0, 0.0, 0.0, 1.0, 1.0)
+REF_SCORES = Scores.from_error_model(ErrorModel(8.0, 0.1, 0.1, 1.0, 1.0))
+SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+SEQ_SCORES = Scores.from_error_model(SEQ_ERRORS)
+SAMPLE_PARAMS = dict(
+    ref_error_rate=0.1,
+    ref_errors=REF_SAMPLE_ERRORS,
+    error_rate=0.005,
+    alpha=1.0,
+    phred_scale=1.5,
+    actual_std=3.0,
+    reported_std=0.3,
+    seq_errors=SEQ_ERRORS,
+)
+
+base_seed = int(sys.argv[1]) if len(sys.argv) > 1 else 1234
+
+combos = list(itertools.product(
+    [True, False], [True, False], [True, False], [True, False], [3, 6]
+))
+fails = []
+for i, (use_ref, dap, seed_indels, ico, batch_size) in enumerate(combos):
+    rng = np.random.default_rng(base_seed + i)
+    ref, template, t_p, seqs, actual, phreds, cb, db = sample_sequences(
+        nseqs=5, length=30, rng=rng, **SAMPLE_PARAMS
+    )
+    params = RifrafParams(
+        scores=SEQ_SCORES,
+        ref_scores=REF_SCORES,
+        do_alignment_proposals=dap,
+        seed_indels=seed_indels,
+        indel_correction_only=ico,
+        batch_size=batch_size,
+        seed=base_seed + i,
+    )
+    result = rifraf(
+        seqs, phreds=phreds, reference=ref if use_ref else None, params=params
+    )
+    ok = decode_seq(result.consensus) == decode_seq(template)
+    tag = f"ref={int(use_ref)} dap={int(dap)} si={int(seed_indels)} ico={int(ico)} bs={batch_size}"
+    print(f"{i:2d} {tag}  {'ok' if ok else 'FAIL'}", flush=True)
+    if not ok:
+        fails.append((i, tag))
+
+print(f"\n{len(combos) - len(fails)}/{len(combos)} recovered (base_seed={base_seed})")
+for i, tag in fails:
+    print("FAIL:", i, tag)

@@ -1,0 +1,78 @@
+"""Measure the existing Pallas forward fill at different COLS_PER_STEP.
+
+Run in a FRESH process per setting (round-2 observed Pallas degrading
+subsequent XLA launches in the same process):
+
+    python exp/pallas_cols.py <cols_per_step> [--tlen 1000] [--reads 256]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import rifraf_tpu.ops.align_pallas as ap
+
+ap.COLS_PER_STEP = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+
+import jax
+import jax.numpy as jnp
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax
+
+TLEN = 1000
+N_READS = 256
+
+scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+rng = np.random.default_rng(0)
+template = rng.integers(0, 4, size=TLEN).astype(np.int8)
+reads = []
+for _ in range(N_READS):
+    slen = int(rng.integers(980, 1020))
+    s = rng.integers(0, 4, size=slen).astype(np.int8)
+    log_p = rng.uniform(-3.0, -1.0, size=slen)
+    reads.append(make_read_scores(s, log_p, 16, scores))
+batch = batch_reads(reads, dtype=np.float32)
+
+print(f"backend={jax.default_backend()} cols_per_step={ap.COLS_PER_STEP}",
+      flush=True)
+
+t0 = time.perf_counter()
+band, score, geom = ap.forward_batch_pallas(template, batch)
+jax.block_until_ready((band, score))
+print(f"compile+run: {time.perf_counter() - t0:.1f}s", flush=True)
+
+# warm timing: repeat calls (prep re-runs on host each call; time the
+# device call separately by pre-prepping once)
+K = band.shape[1]
+NB = (batch.n_reads + 127) // 128
+T1 = TLEN + 1
+n_steps = (T1 + ap.COLS_PER_STEP - 1) // ap.COLS_PER_STEP
+T1p = n_steps * ap.COLS_PER_STEP
+Lbuf = ((max(batch.max_len, T1p) + 2 * K + 8 + 7) // 8) * 8
+geomx = ap.batch_geometry(batch, TLEN)
+match, mismatch, ins, dels, seq, meta = ap._prep_tables(batch, geomx, K, NB, Lbuf)
+t = np.full((T1p, 1), -1, np.int32)
+t[1:T1, 0] = template.astype(np.int32)
+tlen_s = np.array([[TLEN]], np.int32)
+
+args = [jnp.asarray(a) for a in (tlen_s, t, match, mismatch, ins, dels, seq, meta)]
+jax.block_until_ready(args)
+
+best = np.inf
+for i in range(5):
+    t0 = time.perf_counter()
+    out = ap._forward_call(*args, K=K, T1=T1, NB=NB, Lbuf=Lbuf)
+    jax.block_until_ready(out)
+    best = min(best, time.perf_counter() - t0)
+print(f"device-resident fill: {best*1e3:.1f} ms (K={K}, NB={NB}, steps={n_steps})",
+      flush=True)
+
+# correctness vs XLA path
+bands_x, _, scores_x, _ = align_jax.forward_batch(template, batch, tlen=TLEN, K=K)
+ok = np.allclose(np.asarray(score), np.asarray(scores_x), rtol=1e-4, atol=1e-4)
+print(f"scores match XLA: {ok}", flush=True)

@@ -1,0 +1,194 @@
+"""Component-level honest profile of the fused step on the live backend.
+
+Times each device component with an in-jit fori_loop chain (data-dependent
+carry -> pure device time per call, no dispatch overhead) and a single
+dispatch wall (device + dispatch + tunnel). Usage:
+
+    python exp/profile_fused.py [--tlen 1000] [--reads 256] [--bw 16]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("RIFRAF_TPU_CACHE", os.path.expanduser("~/.cache/rifraf_tpu_xla")),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+sys.path.insert(0, "/root/repo")
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax
+from rifraf_tpu.ops.fused import fused_step_full
+from rifraf_tpu.ops.proposal_dense import _dense_batch, dense_tables_blocked, masked_weighted_sum
+
+
+def build(tlen, n_reads, bw, seed=0):
+    scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+    rng = np.random.default_rng(seed)
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n_reads):
+        slen = int(rng.integers(int(tlen * 0.95), int(tlen * 1.05)))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -1.0, size=slen)
+        reads.append(make_read_scores(s, log_p, bw, scores))
+    batch = batch_reads(reads, dtype=np.float32)
+    K = ((align_jax.band_height(batch, tlen) + 7) // 8) * 8
+    geom = align_jax.batch_geometry(batch, tlen)
+    Tpad = ((tlen + 1 + 63) // 64) * 64
+    t_dev = jnp.asarray(np.pad(template, (0, Tpad - tlen)), jnp.int8)
+    w = jnp.ones(n_reads, jnp.float32)
+    dev = {
+        "t": t_dev,
+        "seq": jnp.asarray(batch.seq),
+        "match": jnp.asarray(batch.match),
+        "mismatch": jnp.asarray(batch.mismatch),
+        "ins": jnp.asarray(batch.ins),
+        "dels": jnp.asarray(batch.dels),
+        "geom": geom,
+        "w": w,
+        "K": K,
+    }
+    return dev
+
+
+def chain_time(fn, reps, *args):
+    """Pure device time per call: fori_loop with a data-dependent scalar."""
+    g = lambda eps: fn(eps, *args)  # args are STATIC: close over them
+
+    @jax.jit
+    def looped(eps):
+        def body(_, carry):
+            eps = carry
+            out = g(eps)
+            # fold a scalar of the output back into eps (dependency)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            return eps + 0.0 * jnp.sum(leaf.astype(jnp.float32) * 0.0)
+
+        return jax.lax.fori_loop(0, reps, body, eps)
+
+    r = looped(jnp.float32(0))
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    r = looped(jnp.float32(0))
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / reps
+
+
+def single_time(fn, *args, n=3):
+    f = jax.jit(lambda eps: fn(eps, *args))
+    jax.block_until_ready(f(jnp.float32(0)))
+    best = np.inf
+    for i in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(jnp.float32(i + 1) * 0))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tlen", type=int, default=1000)
+    ap.add_argument("--reads", type=int, default=256)
+    ap.add_argument("--bw", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--only", type=str, default="")
+    ap.add_argument("--chain", action="store_true")
+    args = ap.parse_args()
+
+    print(f"backend={jax.default_backend()}", file=sys.stderr)
+    d = build(args.tlen, args.reads, args.bw)
+    K = d["K"]
+    print(f"K={K} Tpad={d['t'].shape[0]}", file=sys.stderr)
+
+    fwd_bwd = jax.vmap(
+        align_jax._fwd_bwd_one, in_axes=(None, 0, 0, 0, 0, 0, 0, None, None)
+    )
+
+    def fill_only(eps, want_moves):
+        A, moves, scores, B = fwd_bwd(
+            d["t"], d["seq"], d["match"] + eps, d["mismatch"], d["ins"],
+            d["dels"], d["geom"], K, want_moves,
+        )
+        return A, B, scores
+
+    def fill_and_keep(eps, want_moves):
+        return fwd_bwd(
+            d["t"], d["seq"], d["match"] + eps, d["mismatch"], d["ins"],
+            d["dels"], d["geom"], K, want_moves,
+        )
+
+    # precompute A, B, moves once for downstream components
+    A, moves, scores, B = jax.jit(
+        lambda: fill_and_keep(jnp.float32(0), True)
+    )()
+    jax.block_until_ready((A, moves, B))
+
+    def dense_only(eps):
+        subs, insr, dele = _dense_batch(
+            A + eps, B, d["seq"], d["match"], d["mismatch"], d["ins"],
+            d["dels"], d["geom"],
+        )
+        return (masked_weighted_sum(d["w"], subs),
+                masked_weighted_sum(d["w"], insr),
+                masked_weighted_sum(d["w"], dele))
+
+    def dense_blocked_only(eps):
+        return dense_tables_blocked(
+            A + eps, B, d["seq"], d["match"], d["mismatch"], d["ins"],
+            d["dels"], d["geom"], d["w"],
+        )
+
+    def stats_only(eps):
+        statf = jax.vmap(
+            align_jax._traceback_stats_one, in_axes=(0, 0, None, 0, None)
+        )
+        nerr, edits = statf(moves, d["seq"], d["t"], d["geom"], K)
+        return nerr.astype(jnp.float32) + eps, edits
+
+    def fused(eps, want_moves, want_stats):
+        return fused_step_full(
+            d["t"], d["seq"], d["match"] + eps, d["mismatch"], d["ins"],
+            d["dels"], d["geom"], d["w"], K, want_moves, want_stats, 0,
+        )[3]
+
+    all_comps = {
+        "fill": ("fill(no moves)", fill_only, (False,)),
+        "fillm": ("fill(+moves)", fill_only, (True,)),
+        "dense": ("dense_sweep", dense_only, ()),
+        "denseb": ("dense_blocked", dense_blocked_only, ()),
+        "stats": ("tb_stats", stats_only, ()),
+        "fused": ("fused(nostat)", fused, (False, False)),
+        "fuseds": ("fused(stats)", fused, (False, True)),
+        "fusedm": ("fused(moves+stats)", fused, (True, True)),
+    }
+    sel = args.only.split(",") if args.only else list(all_comps)
+    reps = args.reps
+    rows = []
+    for name, fn, a in [all_comps[s] for s in sel]:
+        try:
+            t0 = time.perf_counter()
+            dt_single = single_time(fn, *a)
+            compile_s = time.perf_counter() - t0
+            if args.chain:
+                dt_chain = chain_time(fn, reps, *a)
+                print(f"{name:22s} device={dt_chain*1e3:9.2f} ms  single={dt_single*1e3:9.2f} ms",
+                      flush=True)
+            else:
+                print(f"{name:22s} single={dt_single*1e3:9.2f} ms  (compile+warm {compile_s:.1f}s)",
+                      flush=True)
+        except Exception as e:
+            print(f"{name:22s} FAILED: {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,511 @@
+"""On-core Pallas fill: the banded DP column sweep as ONE kernel.
+
+Second-generation Pallas engine for the reference's hot inner loop
+(/root/reference/src/align.jl:50-179). The XLA scan path (align_jax)
+pays per-column kernel-launch overhead — ~75 ms for a merged
+forward+backward fill at 1 kb x 256 reads where the arithmetic is
+worth ~1 ms (round-4 profile) — and the first-generation kernel
+(align_pallas) iterated ONE column per sequential grid step, losing to
+that same overhead ~100x. This kernel keeps the whole column sweep
+on-core:
+
+- **Uniform band frame.** The first-generation kernel placed each
+  read's band at its own diagonal offset, so score tables had to be
+  pre-shifted per read on the host (align_pallas._prep_tables) and
+  re-uploaded every call. Here every read shares ONE frame: data row d
+  of column j holds cell ``i = d + j - OFF`` with a single batch-wide
+  ``OFF = max_k(offset_k)``; each read keeps its own band LIMITS as a
+  lane mask (``delta_k <= d < delta_k + nd_k``). In-band cells get
+  identical values to the per-read frame (the recurrence only relates
+  same/adjacent data rows, and out-of-band neighbors are -inf in both
+  frames) — pinned by the oracle tests. Table windows become
+  read-independent: column j reads buffer rows [j, j+K) for EVERY
+  lane, so the buffers are just the batch score tables transposed
+  (reads on lanes), built on device with one dynamic_update_slice —
+  no host prep, no per-read shifts, no gathers anywhere.
+  The frame's band-buffer height ``K = max_k(delta_k + nd_k)`` equals
+  the per-read frame's ``max_k(nd_k)`` when reads share a bandwidth
+  and their length spread stays within the bandwidth (the common
+  case; uniform_band_height computes the exact value either way).
+
+- **Reads on lanes, C columns per grid step.** A [K, 128] tile holds
+  one band column for 128 reads; the DP carry lives in a VMEM scratch
+  that persists across the sequentially-iterated column-block axis.
+  Each grid step processes C columns as straight-line code on tiles
+  resident in VMEM: per column, one static [c, c+K) window of each
+  pre-blocked table (block rows are buffer rows [jb*C, jb*C + C + K)),
+  the match/delete candidate maxes, and the within-column insert chain
+  in the same max-plus closed form as the XLA path
+  (``F = G + cummax(cand - G)``, computed along sublanes with
+  log-step rolls).
+
+- **Forward and backward in one launch.** The backward band is the
+  forward DP of the reversed problem with IDENTICAL band geometry
+  (align.jl:196-202), so the reversed-read lanes ride as extra lane
+  blocks in the same grid; a per-block index map picks the reversed
+  template for them. The reversed-problem output is flipped back to
+  backward-band layout by the XLA helper `flip_reversed_uniform`.
+
+Used for score-only fills (the hill-climb hot path). The moves-recording
+variant (SCORE-stage tracebacks, device traceback statistics) stays on
+the XLA path, as does any batch whose uniform-frame K would blow up
+(pathological read-length spread) — see engine.realign for the policy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .align_jax import BandGeometry
+
+# finite sentinel: avoids -inf arithmetic on the VPU (inf - inf = nan in
+# the chain's cand - G); half of float32 min keeps all sums finite
+NEG_INF = float(np.finfo(np.float32).min) / 2
+
+LANES = 128
+
+
+def uniform_frame(geom: BandGeometry):
+    """(OFF, delta, nd) of the shared band frame (dynamic scalars)."""
+    OFF = jnp.max(geom.offset)
+    delta = OFF - geom.offset
+    return OFF, delta, geom.nd
+
+
+def uniform_band_height(geom_host_offsets, geom_host_nd, mult: int = 8) -> int:
+    """Static band-buffer height of the uniform frame: max(delta + nd),
+    rounded up to `mult` (f32 sublane tiling)."""
+    off = np.asarray(geom_host_offsets)
+    nd = np.asarray(geom_host_nd)
+    K = int((off.max() - off + nd).max())
+    return ((K + mult - 1) // mult) * mult
+
+
+def _cumop(x, op, K: int):
+    """Inclusive scan along sublanes (axis 0) via log-step doubling."""
+    s = 1
+    while s < K:
+        shifted = pltpu.roll(x, s, axis=0)
+        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+        x = jnp.where(idx >= s, op(x, shifted), x)
+        s *= 2
+    return x
+
+
+def _fill_kernel(
+    # SMEM inputs
+    tlen_ref,  # [1, 1] true template length
+    off_ref,  # [1, 1] uniform frame offset OFF
+    t_ref,  # [n_tpl, T1p] template codes per stream
+    # per-lane metadata, [1, 1, 128] blocks
+    slen_ref,
+    delta_ref,
+    ndv_ref,
+    dend_ref,
+    # pre-blocked tables, [1, CB, 128] blocks (buffer rows [jb*C, jb*C+CB))
+    mt_ref,
+    mm_ref,
+    gi_ref,
+    dl_ref,
+    sq_ref,
+    # outputs
+    out_ref,  # VMEM [C * K, 128] band columns of this step
+    score_ref,  # VMEM [1, 128] final scores (written on the last step)
+    # scratch
+    carry,  # VMEM [K, 128] previous column
+    acc_score,  # VMEM [1, 128]
+    *,
+    K: int,
+    C: int,
+    blocks_per_tpl: int,
+):
+    jb = pl.program_id(1)
+    stream = pl.program_id(0) // blocks_per_tpl
+    tlen = tlen_ref[0, 0]
+    OFF = off_ref[0, 0]
+
+    slen = slen_ref[0, 0, :]
+    delta = delta_ref[0, 0, :]
+    nd = ndv_ref[0, 0, :]
+    d = jax.lax.broadcasted_iota(jnp.int32, (K, LANES), 0)
+    neg = jnp.full((K, LANES), NEG_INF, jnp.float32)
+    in_lane_band = (d >= delta[None, :]) & (d < (delta + nd)[None, :])
+
+    @pl.when(jb == 0)
+    def _():
+        acc_score[:] = jnp.full((1, LANES), NEG_INF, jnp.float32)
+
+    prev = carry[:]
+    for c in range(C):
+        j = jb * C + c
+        i = d + (j - OFF)
+        valid = (i >= 0) & (i <= slen[None, :]) & in_lane_band & (j <= tlen)
+
+        # static windows of the pre-blocked tables: column j = block row c
+        mw = mt_ref[0, c : c + K, :]
+        mmw = mm_ref[0, c : c + K, :]
+        giw = gi_ref[0, c : c + K, :]
+        dlw = dl_ref[0, c : c + K, :]
+        sqw = sq_ref[0, c : c + K, :]
+
+        tb = t_ref[stream, j]  # template base of column j (junk at j == 0)
+
+        # j == 0: only cell (0, 0) seeds the recurrence
+        first = j == 0
+        msc = jnp.where(sqw == tb, mw, mmw)
+        mcand = jnp.where((i >= 1) & jnp.logical_not(first), prev + msc, neg)
+        prev_up = pltpu.roll(prev, K - 1, axis=0)  # prev_up[d] = prev[d+1]
+        prev_up = jnp.where(d == K - 1, neg, prev_up)
+        dcand = jnp.where(first, neg, prev_up + dlw)
+        cand = jnp.maximum(mcand, dcand)
+        cand = jnp.where(first & (i == 0), 0.0, cand)
+        cand = jnp.where(valid, cand, neg)
+
+        # within-column insert chain F[d] = max(cand[d], F[d-1] + g[d]):
+        # max-plus closed form F = G + cummax(cand - G), G = cumsum(g);
+        # valid because a column's in-band rows are contiguous in d
+        g = jnp.where((i >= 1) & valid, giw, 0.0)
+        G = _cumop(g, lambda a, b: a + b, K)
+        F = G + _cumop(cand - G, jnp.maximum, K)
+        F = jnp.where(valid, F, neg)
+
+        prev = F
+        out_ref[c * K : (c + 1) * K, :] = F
+
+        @pl.when(j == tlen)
+        def _():
+            dend = dend_ref[0, 0, :]
+            sel = jnp.where(d == dend[None, :], F, NEG_INF)
+            acc_score[:] = jnp.max(sel, axis=0, keepdims=True)
+
+    carry[:] = prev
+
+    @pl.when(jb == pl.num_programs(1) - 1)
+    def _():
+        score_ref[:] = acc_score[:]
+
+
+def _pick_cols(T1p: int, K: int, vmem_budget: int = 9 << 20) -> int:
+    """Columns per grid step: the largest divisor of T1p whose working
+    set (double-buffered output block [C*K, 128] f32 + 5 double-buffered
+    table blocks [C+K, 128]) fits the VMEM budget. T1p is a multiple of
+    64 for bucketed templates."""
+    best = 1
+    c = 1
+    while c <= min(T1p, 512):
+        if T1p % c == 0:
+            need = 2 * 128 * 4 * (c * K + 5 * (c + K))
+            if need <= vmem_budget:
+                best = c
+        c *= 2
+    return best
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "T1p", "NBLK", "C", "interpret")
+)
+def _fill_call(
+    tlen_s,  # [1, 1] int32
+    off_s,  # [1, 1] int32
+    t_cols,  # [n_tpl, T1p] int32; row b//NB_per_tpl... (see index map)
+    meta,  # [4, 1, Npad] int32: slen, delta, nd, dend
+    mt, mm, gi, dl, sq,  # [NSTEPS, CB, Npad] pre-blocked tables
+    K: int,
+    T1p: int,
+    NBLK: int,
+    C: int,
+    interpret: bool = False,
+):
+    n_steps = T1p // C
+    CB = mt.shape[1]
+    n_tpl = t_cols.shape[0]
+    blocks_per_tpl = NBLK // n_tpl
+
+    grid = (NBLK, n_steps)
+
+    def tab_spec():
+        return pl.BlockSpec(
+            (1, CB, LANES), lambda nb, jb: (jb, 0, nb),
+            memory_space=pltpu.VMEM,
+        )
+
+    def lane_spec():
+        return pl.BlockSpec(
+            (1, 1, LANES), lambda nb, jb: (0, 0, nb),
+            memory_space=pltpu.VMEM,
+        )
+
+    kernel = functools.partial(
+        _fill_kernel, K=K, C=C, blocks_per_tpl=blocks_per_tpl
+    )
+
+    out_band, scores = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+            # whole template table (TPU SMEM blocks must span the trailing
+            # dims); the kernel indexes [stream, column] dynamically
+            pl.BlockSpec(
+                (n_tpl, T1p), lambda nb, jb: (0, 0),
+                memory_space=pltpu.SMEM,
+            ),
+            lane_spec(),  # slen
+            lane_spec(),  # delta
+            lane_spec(),  # nd
+            lane_spec(),  # dend
+            tab_spec(),  # mt
+            tab_spec(),  # mm
+            tab_spec(),  # gi
+            tab_spec(),  # dl
+            tab_spec(),  # sq
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (C * K, LANES), lambda nb, jb: (jb, nb),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, LANES), lambda nb, jb: (0, nb), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_steps * C * K, NBLK * LANES), jnp.float32),
+            jax.ShapeDtypeStruct((1, NBLK * LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K, LANES), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        tlen_s, off_s, t_cols,
+        meta[0][None], meta[1][None], meta[2][None], meta[3][None],
+        mt, mm, gi, dl, sq,
+    )
+    return out_band, scores
+
+
+def _block_tables(buf, n_steps: int, C: int, CB: int):
+    """[Lbuf, Npad] -> [n_steps, CB, Npad]: block jb holds buffer rows
+    [jb*C, jb*C + CB) (the halo'd window its C columns read)."""
+    return jnp.stack(
+        [jax.lax.dynamic_slice_in_dim(buf, jb * C, CB, axis=0)
+         for jb in range(n_steps)]
+    )
+
+
+def _reverse_rows(a, lengths):
+    """Reverse each row's true-length prefix (tail padding stays)."""
+    L = a.shape[1]
+    k = jnp.arange(L)
+    idx = jnp.where(k[None, :] < lengths[:, None],
+                    lengths[:, None] - 1 - k[None, :], k[None, :])
+    return jnp.take_along_axis(a, idx, axis=1)
+
+
+def _reverse_rows1(a, lengths):
+    """Like _reverse_rows for the length-(L+1) dels tables."""
+    L1 = a.shape[1]
+    k = jnp.arange(L1)
+    idx = jnp.where(k[None, :] <= lengths[:, None],
+                    lengths[:, None] - k[None, :], k[None, :])
+    return jnp.take_along_axis(a, idx, axis=1)
+
+
+class FillBuffers(NamedTuple):
+    """Device-resident, template-independent fill inputs: the transposed
+    (+reversed, for the backward stream) score tables and lane metadata
+    minus frame placement. Built once per batch selection
+    (engine.realign caches this; only the template changes per call)."""
+
+    seq_T: jnp.ndarray  # [L, Npad] int32, fwd lanes
+    match_T: jnp.ndarray
+    mismatch_T: jnp.ndarray
+    ins_T: jnp.ndarray
+    dels_T: jnp.ndarray  # [L + 1, Npad]
+    rseq_T: jnp.ndarray  # reversed-read lanes
+    rmatch_T: jnp.ndarray
+    rmismatch_T: jnp.ndarray
+    rins_T: jnp.ndarray
+    rdels_T: jnp.ndarray
+    lengths: jnp.ndarray  # [Npad] int32 (0 for padding lanes)
+
+
+def _pad_lanes(a, Npad: int, fill=0.0):
+    n = a.shape[0]
+    if n == Npad:
+        return a
+    pad = jnp.full((Npad - n,) + a.shape[1:], fill, a.dtype)
+    return jnp.concatenate([a, pad], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("Npad",))
+def build_fill_buffers(seq, match, mismatch, ins, dels, lengths,
+                       Npad: int) -> FillBuffers:
+    """Transpose the batch tables to lanes-last and precompute the
+    reversed-read variants (template-independent; cache per batch)."""
+    f32 = jnp.float32
+    sq = _pad_lanes(seq.astype(jnp.int32), Npad, -9)
+    mt = _pad_lanes(match.astype(f32), Npad)
+    mm = _pad_lanes(mismatch.astype(f32), Npad)
+    gi = _pad_lanes(ins.astype(f32), Npad)
+    dl = _pad_lanes(dels.astype(f32), Npad)
+    ln = _pad_lanes(lengths.astype(jnp.int32), Npad)
+    return FillBuffers(
+        seq_T=sq.T, match_T=mt.T, mismatch_T=mm.T, ins_T=gi.T, dels_T=dl.T,
+        rseq_T=_reverse_rows(sq, ln).T,
+        rmatch_T=_reverse_rows(mt, ln).T,
+        rmismatch_T=_reverse_rows(mm, ln).T,
+        rins_T=_reverse_rows(gi, ln).T,
+        rdels_T=_reverse_rows1(dl, ln).T,
+        lengths=ln,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "T1p", "C", "with_backward", "interpret")
+)
+def fill_uniform(
+    template,  # int8 [Tmax] padded template
+    tlen,  # int32 true length
+    bufs: FillBuffers,
+    geom: BandGeometry,  # per-read (offset may exceed lanes: padded below)
+    K: int,
+    T1p: int,
+    C: int = 0,
+    with_backward: bool = True,
+    interpret: bool = False,
+):
+    """Pallas banded fill in the uniform frame.
+
+    Returns (A [N, K, T1p], Brev or None, scores [N], OFF) where A is the
+    forward band, Brev the RAW reversed-problem forward band (flip to
+    backward layout with flip_reversed_uniform), and scores[k] =
+    A[dend_k, tlen]. N = lane count (callers slice off padding lanes).
+    """
+    Npad = bufs.seq_T.shape[1]
+    NB = Npad // LANES
+    if C <= 0:
+        C = _pick_cols(T1p, K)
+    n_steps = T1p // C
+    CB = C + K
+
+    tlen = jnp.asarray(tlen, jnp.int32)
+    OFF = jnp.max(geom.offset).astype(jnp.int32)
+    delta = _pad_lanes((OFF - geom.offset).astype(jnp.int32), Npad)
+    ndv = _pad_lanes(geom.nd.astype(jnp.int32), Npad)
+    slen = bufs.lengths
+    dend = slen - tlen + OFF
+
+    # the kernel only reads buffer rows [0, T1p + K); build the buffer
+    # with enough tail room that the placement below never clips (OFF is
+    # bounded by tlen + bandwidth <= T1p - 1 + K), then drop the unread
+    # tail before blocking
+    L = bufs.seq_T.shape[0]
+    Lbuf = T1p + K + 8
+    Lbig = Lbuf + L
+
+    def place(tab_T, row0, fill):
+        # buffer row r holds table index r - (OFF + 1) (dl: r - OFF):
+        # column j's window is rows [j, j + K) for every lane
+        buf = jnp.full((Lbig, Npad), fill, tab_T.dtype)
+        buf = jax.lax.dynamic_update_slice(
+            buf, tab_T, (row0.astype(jnp.int32), jnp.int32(0))
+        )
+        return buf[:Lbuf]
+
+    row_tab = OFF + 1
+    row_dl = OFF
+
+    def stream(sqT, mtT, mmT, giT, dlT):
+        return (
+            _block_tables(place(mtT, row_tab, 0.0), n_steps, C, CB),
+            _block_tables(place(mmT, row_tab, 0.0), n_steps, C, CB),
+            _block_tables(place(giT, row_tab, 0.0), n_steps, C, CB),
+            _block_tables(place(dlT, row_dl, 0.0), n_steps, C, CB),
+            _block_tables(place(sqT, row_tab, -9), n_steps, C, CB),
+        )
+
+    f_mt, f_mm, f_gi, f_dl, f_sq = stream(
+        bufs.seq_T, bufs.match_T, bufs.mismatch_T, bufs.ins_T, bufs.dels_T
+    )
+
+    # template columns: row j holds t[j - 1] (row 0 unused); pad to T1p
+    def to_cols(t):
+        cols = jnp.concatenate([t[:1], t]).astype(jnp.int32)
+        return jnp.pad(cols, (0, T1p - cols.shape[0]))
+
+    tpl = to_cols(template)
+
+    meta_rows = [slen, delta, ndv, dend]
+
+    if with_backward:
+        # reversed template: reverse the true-length prefix
+        k = jnp.arange(template.shape[0])
+        ridx = jnp.clip(tlen - 1 - k, 0, template.shape[0] - 1)
+        rtemplate = jnp.where(k < tlen, template[ridx], template[k])
+        rtpl = to_cols(rtemplate)
+        r_mt, r_mm, r_gi, r_dl, r_sq = stream(
+            bufs.rseq_T, bufs.rmatch_T, bufs.rmismatch_T, bufs.rins_T,
+            bufs.rdels_T,
+        )
+        mt = jnp.concatenate([f_mt, r_mt], axis=2)
+        mm = jnp.concatenate([f_mm, r_mm], axis=2)
+        gi = jnp.concatenate([f_gi, r_gi], axis=2)
+        dl = jnp.concatenate([f_dl, r_dl], axis=2)
+        sq = jnp.concatenate([f_sq, r_sq], axis=2)
+        t_cols = jnp.stack([tpl, rtpl])
+        meta = jnp.stack(
+            [jnp.concatenate([m, m])[None] for m in meta_rows]
+        )
+        NBLK = 2 * NB
+    else:
+        mt, mm, gi, dl, sq = f_mt, f_mm, f_gi, f_dl, f_sq
+        t_cols = tpl[None]
+        meta = jnp.stack([m[None] for m in meta_rows])
+        NBLK = NB
+
+    tlen_s = jnp.reshape(tlen.astype(jnp.int32), (1, 1))
+    off_s = jnp.reshape(OFF, (1, 1))
+    band_flat, scores = _fill_call(
+        tlen_s, off_s, t_cols, meta, mt, mm, gi, dl, sq,
+        K=K, T1p=T1p, NBLK=NBLK, C=C, interpret=interpret,
+    )
+    # [n_steps*C*K, NBLK*128] -> [T1p, K, NBLK*128] -> [lanes, K, T1p]
+    band = band_flat.reshape(T1p, K, NBLK * LANES).transpose(2, 1, 0)
+    A = band[:Npad]
+    if with_backward:
+        Brev = band[Npad:]
+        return A, Brev, scores[0, :Npad], OFF
+    return A, None, scores[0, :Npad], OFF
+
+
+@functools.partial(jax.jit, static_argnames=("K",))
+def flip_reversed_uniform(Brev, tlen, slen, OFF, K: int):
+    """Map the reversed-problem forward band into backward-band layout in
+    the uniform frame: B[d, j] = Brev[S - d, tlen - j] with
+    S = slen - tlen + 2*OFF (derivation: i_rev = slen - i,
+    j_rev = tlen - j, d_rev = i_rev - j_rev + OFF)."""
+    T1p = Brev.shape[-1]
+
+    def flip_one(b, S):
+        f = b[::-1, ::-1]  # rows: K-1-d; cols: T1p-1-j
+        # want row S - d = (K-1-d) shifted by S - (K-1)
+        f = jnp.roll(f, S - (K - 1), axis=0)
+        f = jnp.roll(f, tlen + 1 - T1p, axis=1)
+        return f
+
+    S = slen - tlen + 2 * OFF
+    return jax.vmap(flip_one)(Brev, S)

@@ -1,0 +1,136 @@
+"""Oracle check for ops.dense_pallas.fused_step_pallas vs the XLA dense
+sweep (ops.proposal_dense.score_all_edits) and XLA fills.
+
+CPU interpret mode by default; --tpu for the real kernels; --time for
+warm timings at scale.
+"""
+
+import os
+import sys
+import time
+
+interpret = "--tpu" not in sys.argv
+if interpret:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+if interpret:
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, "/root/repo")
+
+import jax.numpy as jnp
+import numpy as np
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax, dense_pallas, fill_pallas
+from rifraf_tpu.ops.proposal_dense import score_all_edits
+
+TLEN = int(os.environ.get("TLEN", "40"))
+N_READS = int(os.environ.get("NREADS", "5"))
+BW = int(os.environ.get("BW", "6"))
+
+scores_m = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+rng = np.random.default_rng(11)
+template = rng.integers(0, 4, size=TLEN).astype(np.int8)
+reads = []
+for n in range(N_READS):
+    slen = int(rng.integers(max(4, TLEN - 8), TLEN + 9))
+    s = rng.integers(0, 4, size=slen).astype(np.int8)
+    log_p = rng.uniform(-3.0, -1.0, size=slen)
+    reads.append(make_read_scores(s, log_p, BW, scores_m))
+batch = batch_reads(reads, dtype=np.float32)
+
+tlen = TLEN
+geom = align_jax.batch_geometry(batch, tlen)
+K = fill_pallas.uniform_band_height(np.asarray(geom.offset), np.asarray(geom.nd))
+Tmax = ((tlen + 63) // 64) * 64
+T1p = Tmax + 64
+C = dense_pallas.pick_dense_cols(T1p, K)
+tpl_pad = np.zeros(Tmax, np.int8)
+tpl_pad[:tlen] = template
+Npad = ((batch.n_reads + 127) // 128) * 128
+lengths = np.asarray(batch.lengths)
+r_unique = tuple(sorted(set(int(x) for x in lengths - lengths.min())))
+
+bufs = fill_pallas.build_fill_buffers(
+    jnp.asarray(batch.seq), jnp.asarray(batch.match),
+    jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+    jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
+)
+weights = np.ones(batch.n_reads, np.float32)
+weights[min(1, batch.n_reads - 1)] = 0.0  # exercise zero-weight masking
+
+t0 = time.perf_counter()
+packed = dense_pallas.fused_step_pallas(
+    jnp.asarray(tpl_pad), jnp.int32(tlen), bufs, geom,
+    jnp.asarray(weights), K, T1p, C, r_unique, interpret=interpret,
+)
+packed = np.asarray(packed)
+print(f"fused_step_pallas: {time.perf_counter() - t0:.1f}s compile+run "
+      f"K={K} T1p={T1p} C={C} r_unique={r_unique}", flush=True)
+
+lay = dense_pallas.pack_layout_pallas(Npad, T1p)
+total = packed[0]
+sc = packed[slice(*lay["scores"])][: batch.n_reads]
+sub_t = packed[slice(*lay["sub"])].reshape(T1p, 4)
+ins_t = packed[slice(*lay["ins"])].reshape(T1p, 4)
+del_t = packed[slice(*lay["del"])]
+
+# --- oracles (XLA per-read frame) ---
+Kx = align_jax.band_height(batch, tlen)
+A, _, scores_x, _ = align_jax.forward_batch(tpl_pad, batch, tlen=tlen, K=Kx)
+B, _, _ = align_jax.backward_batch(tpl_pad, batch, tlen=tlen, K=Kx)
+sub_x, ins_x, del_x = score_all_edits(A, B, batch, geom, jnp.asarray(weights))
+sub_x, ins_x, del_x = (np.asarray(v) for v in (sub_x, ins_x, del_x))
+scores_x = np.asarray(scores_x)
+
+ok = True
+if not np.allclose(sc, scores_x, rtol=1e-5, atol=1e-5):
+    print("SCORES mismatch", sc[:5], scores_x[:5])
+    ok = False
+want_total = float(np.sum(np.where(weights > 0, scores_x, 0.0) * weights))
+if not np.isclose(total, want_total, rtol=1e-5):
+    print("TOTAL mismatch", total, want_total)
+    ok = False
+
+# sub/del valid at pos < tlen; ins at pos <= tlen
+for name, got, want, hi in (
+    ("sub", sub_t, sub_x, tlen),
+    ("ins", ins_t, ins_x, tlen + 1),
+    ("del", del_t, del_x, tlen),
+):
+    g, w_ = got[:hi], want[:hi]
+    finite = np.isfinite(w_)
+    if not np.allclose(g[finite], w_[finite], rtol=2e-5, atol=2e-5):
+        bad = np.argwhere(~np.isclose(g, w_, rtol=2e-5, atol=2e-5) & finite)
+        print(f"{name} mismatch at {bad[:6].tolist()} "
+              f"got={g[tuple(bad[0])]} want={w_[tuple(bad[0])]}")
+        ok = False
+    # -inf oracle entries must be hugely negative on the pallas side too
+    if finite.size and np.any(g[~finite] > -1e30):
+        print(f"{name}: masked entries not negative")
+        ok = False
+print("tables match:", ok, flush=True)
+
+if "--time" in sys.argv:
+    tpl_dev = jnp.asarray(tpl_pad)
+    w_dev = jnp.asarray(weights)
+    jax.block_until_ready(bufs)
+    best = np.inf
+    for i in range(6):
+        t0 = time.perf_counter()
+        r = dense_pallas.fused_step_pallas(
+            tpl_dev, jnp.int32(tlen), bufs, geom, w_dev, K, T1p, C,
+            r_unique, interpret=interpret,
+        )
+        jax.block_until_ready(r)
+        dt = time.perf_counter() - t0
+        if i:
+            best = min(best, dt)
+        print(f"  warm fused_pallas: {dt*1e3:.1f} ms", flush=True)
+    print(f"fused_pallas best: {best*1e3:.1f} ms", flush=True)
+
+sys.exit(0 if ok else 1)

@@ -1,0 +1,351 @@
+"""Device-resident hill-climb: a whole INIT/REFINE stage in ONE dispatch.
+
+The host driver (engine.driver) pays a dispatch plus a packed
+device->host fetch EVERY iteration — a fixed ~75-100 ms round trip each
+on the tunneled TPU (BASELINE.md), dwarfing the per-iteration device
+work once the Pallas kernels run it in ~20 ms. This module runs the
+reference's hill-climbing loop for one stage (model.jl:1150-1227,
+restricted to the no-reference INIT/REFINE stages) as a single
+``lax.while_loop``: per iteration it computes the dense all-edit score
+tables on device, selects improving candidates (choose_candidates'
+greedy min-dist filter, proposals.jl:104-115), applies them to a padded
+template buffer (apply_proposals, proposals.jl:80-102), re-scores, and
+applies the multi-candidate rollback (model.jl:898-935) — fetching
+NOTHING until the stage converges; the final state comes back in one
+packed array.
+
+Bit-identity with the host driver: candidate scores come from the same
+dense tables, ties break in the same generation order (all_proposals'
+emission order == the flat layout's index order; both
+``sorted(..., reverse=True)`` and ``top_k`` are stable), the min-dist
+filter walks candidates in the same order, and the rollback uses the
+same np.isclose formula — asserted by tests/test_device_loop.py.
+
+Eligibility (enforced by the driver): full-batch (no subsampling),
+do_alignment_proposals=False (the dense tables score ALL edits anyway;
+the traceback-restricted candidate SET of model.jl:483-497 is a
+different algorithm), min_dist >= 2 (the vectorized apply relies on
+chosen proposals touching distinct anchors), bandwidths settled. Falls
+back to the host loop mid-stage (without losing work) when the
+improving-candidate count exceeds the top-k cap or the template drifts
+too far from its entry length for the compiled band margins.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CAP = 192  # top-k candidate cap; overflow falls back to the host loop
+MAX_DRIFT = 48  # max template-length drift inside one compiled loop
+NEG = jnp.float32(np.finfo(np.float32).min / 2)
+
+
+class StageResult(NamedTuple):
+    consensus: np.ndarray
+    score: float
+    n_iters: int
+    history: list  # per-iteration consensus snapshots (iteration tops)
+    completed: bool  # stage ended itself (no candidates / score stall)
+
+
+def _candidate_scores(sub_t, ins_t, del_t, tmpl, tlen, total, do_indels,
+                      Tmax: int):
+    """Flat candidate score vector in all_proposals' emission order:
+    [Ins(0, b) x4] then per position j: [Sub(j, b) x4, Del(j),
+    Ins(j+1, b) x4]. Ineligible slots (own-base substitutions, positions
+    beyond tlen, indels when disabled, non-improving) hold NEG."""
+    j = jnp.arange(Tmax)
+    live = j < tlen
+    sub = jnp.where(
+        live[:, None] & (jnp.arange(4)[None, :] != tmpl[:Tmax, None]),
+        sub_t[:Tmax],
+        NEG,
+    )
+    if do_indels:
+        dele = jnp.where(live, del_t[:Tmax], NEG)
+        ins0 = ins_t[0]
+        ins_next = jnp.where((j[:, None] + 1) <= tlen, ins_t[1 : Tmax + 1], NEG)
+    else:
+        dele = jnp.full((Tmax,), NEG)
+        ins0 = jnp.full((4,), NEG)
+        ins_next = jnp.full((Tmax, 4), NEG)
+    blocks = jnp.concatenate([sub, dele[:, None], ins_next], axis=1)
+    flat = jnp.concatenate([ins0, blocks.reshape(-1)])
+    return jnp.where(flat > total, flat, NEG)
+
+
+def _decode(idx):
+    """Flat index -> (kind, pos, base, anchor); kind 0 sub, 1 del, 2 ins.
+    anchor matches proposals.anchor: Insertion -> pos, others -> pos+1."""
+    is0 = idx < 4
+    r = jnp.maximum(idx - 4, 0)
+    j = r // 9
+    k = r % 9
+    kind = jnp.where(is0, 2, jnp.where(k < 4, 0, jnp.where(k == 4, 1, 2)))
+    pos = jnp.where(is0, 0, jnp.where(k <= 4, j, j + 1))
+    base = jnp.where(is0, idx, jnp.where(k < 4, k, jnp.where(k == 4, 0, k - 5)))
+    anchor = jnp.where(kind == 2, pos, pos + 1)
+    return kind, pos, base, anchor
+
+
+def _choose(cand_flat, min_dist: int):
+    """top-k + greedy min-dist filter (choose_candidates,
+    proposals.jl:104-115). Returns (kind, pos, base, keep, n_improving,
+    best_score)."""
+    vals, idxs = jax.lax.top_k(cand_flat, CAP)
+    ok = vals > NEG
+    n_improving = jnp.sum((cand_flat > NEG).astype(jnp.int32))
+    kind, pos, base, anchor = _decode(idxs)
+
+    def body(c, kept_anchor):
+        a = anchor[c]
+        clash = jnp.any(
+            (jnp.abs(a - kept_anchor) < min_dist) & (kept_anchor >= 0)
+        )
+        keep_c = ok[c] & jnp.logical_not(clash)
+        return kept_anchor.at[c].set(jnp.where(keep_c, a, -(10**9)))
+
+    kept_anchor = jax.lax.fori_loop(
+        0, CAP, body, jnp.full((CAP,), -(10**9), jnp.int32)
+    )
+    keep = kept_anchor >= 0
+    return kind, pos, base, keep, n_improving, vals[0]
+
+
+def _apply(tmpl, tlen, kind, pos, base, keep, Tmax: int):
+    """Vectorized apply_proposals (proposals.jl:80-102) for a
+    min-dist-separated set: at most one edit per anchor, so no
+    deletion+insertion interactions; every kept edit lands at an
+    independent position."""
+    is_sub = keep & (kind == 0)
+    is_del = keep & (kind == 1)
+    is_ins = keep & (kind == 2)
+    sub_mark = jnp.zeros((Tmax,), bool).at[pos].max(is_sub, mode="drop")
+    sub_base = jnp.zeros((Tmax,), jnp.int8).at[pos].max(
+        jnp.where(is_sub, base, 0).astype(jnp.int8), mode="drop"
+    )
+    del_mark = jnp.zeros((Tmax,), bool).at[pos].max(is_del, mode="drop")
+    ins_mark = jnp.zeros((Tmax + 1,), bool).at[
+        jnp.where(is_ins, pos, Tmax + 1)
+    ].max(is_ins, mode="drop")
+    ins_base = jnp.zeros((Tmax + 1,), jnp.int8).at[
+        jnp.where(is_ins, pos, Tmax + 1)
+    ].max(jnp.where(is_ins, base, 0).astype(jnp.int8), mode="drop")
+    j = jnp.arange(Tmax)
+    livej = j < tlen
+    sub_mark = sub_mark & livej
+    del_mark = del_mark & livej
+    ins_mark = ins_mark & (jnp.arange(Tmax + 1) <= tlen)
+
+    inc_ins = jnp.cumsum(ins_mark.astype(jnp.int32))  # #ins at q <= p
+    exc_ins = jnp.concatenate([jnp.zeros((1,), jnp.int32), inc_ins[:-1]])
+    cum_del = jnp.cumsum(del_mark.astype(jnp.int32))
+    exc_del = jnp.concatenate([jnp.zeros((1,), jnp.int32), cum_del])
+
+    out = jnp.zeros((Tmax,), jnp.int8)
+    newbase = jnp.where(sub_mark, sub_base, tmpl[:Tmax])
+    # base at j lands after every insertion at q <= j and loses a slot
+    # per deletion at q < j; insertion at p goes before original index p
+    w_base = j + inc_ins[:Tmax] - exc_del[:Tmax]
+    put_base = livej & jnp.logical_not(del_mark)
+    out = out.at[jnp.where(put_base, w_base, Tmax)].set(newbase, mode="drop")
+    p1 = jnp.arange(Tmax + 1)
+    w_ins = p1 + exc_ins - exc_del[: Tmax + 1]
+    out = out.at[jnp.where(ins_mark, w_ins, Tmax)].set(
+        ins_base, mode="drop"
+    )
+    new_tlen = tlen + inc_ins[-1] - cum_del[-1]
+    return out, new_tlen
+
+
+def _isclose(a, b):
+    """np.isclose with default tolerances (the rollback comparison,
+    driver.handle_candidates / model.jl:917-919)."""
+    return jnp.abs(a - b) <= 1e-8 + 1e-5 * jnp.abs(b)
+
+
+def make_stage_runner(
+    step_fn: Callable,  # (tmpl, tlen, step_state) -> (total, sub, ins, del)
+    do_indels: bool,
+    min_dist: int,
+    H: int,  # history capacity = params.max_iters + 1 (static)
+    Tmax: int,
+    stop_on_same: bool,
+):
+    """Build the jitted whole-stage runner. ``step_fn`` takes the
+    device-resident batch state as an ARGUMENT pytree (not a closure) so
+    one compiled runner serves every batch of the same shape — callers
+    cache via engine.realign's lru-cached factories. ``stop_on_same``
+    mirrors check_score's full-batch stall exit (driver.check_score
+    requires batch_size == len(sequences) for it)."""
+
+    def cond(carry):
+        return jnp.logical_not(carry["done"]) & (
+            carry["it"] < carry["iters_left"]
+        )
+
+    def body(carry):
+        tmpl, tlen = carry["tmpl"], carry["tlen"]
+        total, sub_t, ins_t, del_t = carry["tables"]
+        it = carry["it"]
+        # record this iteration's starting consensus (the driver appends
+        # to consensus_stages at every iteration top)
+        hist = jax.lax.dynamic_update_slice(
+            carry["hist"], tmpl[None], (it, jnp.zeros_like(it))
+        )
+        hlen = carry["hlen"].at[it].set(tlen)
+
+        # check_score, full-batch case: unchanged score at the top of a
+        # non-first stage iteration ends the stage (driver.check_score's
+        # cur_iters > 1; prev_iters counts host iterations already spent
+        # in this stage before the device loop took over)
+        if stop_on_same:
+            stop_same = ((it + carry["prev_iters"]) > 0) & (
+                total == carry["old_score"]
+            )
+        else:
+            stop_same = jnp.asarray(False)
+
+        cand = _candidate_scores(
+            sub_t, ins_t, del_t, tmpl, tlen, total, do_indels, Tmax
+        )
+        kind, pos, base, keep, n_improving, best = _choose(cand, min_dist)
+        no_cand = n_improving == 0
+        overflow = n_improving > CAP
+
+        tmpl_multi, tlen_multi = _apply(tmpl, tlen, kind, pos, base, keep, Tmax)
+        n_keep = jnp.sum(keep.astype(jnp.int32))
+        # stay inside the padded buffer / compiled band-height margin
+        drift = (tlen_multi + 1 >= Tmax) | (
+            jnp.abs(tlen_multi - carry["tlen0"]) > MAX_DRIFT
+        )
+        bail = (overflow | drift) & jnp.logical_not(stop_same | no_cand)
+        done = stop_same | no_cand | bail
+        do_work = jnp.logical_not(done)
+
+        def work(_):
+            # handle_candidates: apply all chosen, re-score; if multiple
+            # and the combination is no better than the best single,
+            # roll back to the single best (which the next fill scores)
+            total2, sub2, ins2, del2 = step_fn(
+                tmpl_multi, tlen_multi, carry["step_state"]
+            )
+            rollback = (n_keep > 1) & (
+                (total2 < best) | _isclose(total2, best)
+            )
+
+            def single(_):
+                keep1 = keep & (jnp.cumsum(keep.astype(jnp.int32)) == 1)
+                tmpl1, tlen1 = _apply(tmpl, tlen, kind, pos, base, keep1, Tmax)
+                return (tmpl1, tlen1) + (
+                    step_fn(tmpl1, tlen1, carry["step_state"]),
+                )
+
+            def multi(_):
+                return tmpl_multi, tlen_multi, (total2, sub2, ins2, del2)
+
+            return jax.lax.cond(rollback, single, multi, None)
+
+        def no_work(_):
+            return tmpl, tlen, (total, sub_t, ins_t, del_t)
+
+        tmpl_n, tlen_n, tables_n = jax.lax.cond(do_work, work, no_work, None)
+        return {
+            "tmpl": tmpl_n,
+            "tlen": tlen_n,
+            "tables": tables_n,
+            "old_score": total,
+            "done": done,
+            "bail": carry["bail"] | bail,
+            "it": it + jnp.where(done, 0, 1),
+            # a bailed iteration was ABORTED before applying anything:
+            # the host must redo it, so it is not counted or recorded
+            "n_rec": jnp.where(bail, it, it + 1),
+            "hist": hist,
+            "hlen": hlen,
+            "tlen0": carry["tlen0"],
+            "iters_left": carry["iters_left"],
+            "prev_iters": carry["prev_iters"],
+            "step_state": carry["step_state"],
+        }
+
+    @jax.jit
+    def run(tmpl0, tlen0, prev_score, iters_left, prev_iters, step_state):
+        tables0 = step_fn(tmpl0, tlen0, step_state)
+        carry = {
+            "tmpl": tmpl0,
+            "tlen": tlen0,
+            "tables": tables0,
+            # match the step dtype (f64 under x64) or the while_loop
+            # carry would change dtype across iterations
+            "old_score": prev_score.astype(tables0[0].dtype),
+            "done": jnp.asarray(False),
+            "bail": jnp.asarray(False),
+            "it": jnp.int32(0),
+            "n_rec": jnp.int32(0),
+            "hist": jnp.zeros((H, Tmax), jnp.int8),
+            "hlen": jnp.zeros((H,), jnp.int32),
+            "tlen0": tlen0,
+            "iters_left": iters_left,
+            "prev_iters": prev_iters,
+            "step_state": step_state,
+        }
+        out = jax.lax.while_loop(cond, body, carry)
+        # ONE packed fetch: scalars, per-iteration lengths, history,
+        # template — in the step dtype so the final score survives intact
+        pdt = out["tables"][0].dtype
+        packed = jnp.concatenate([
+            jnp.stack([
+                out["tlen"].astype(pdt),
+                out["tables"][0],
+                out["n_rec"].astype(pdt),
+                # completed = the stage ENDED ITSELF (no candidates /
+                # score stall): a bail or an iters_left exhaustion exits
+                # with done's natural-termination causes absent, and the
+                # host loop must keep iterating, not finish_stage
+                (out["done"] & jnp.logical_not(out["bail"])).astype(pdt),
+            ]),
+            out["hlen"].astype(pdt),
+            out["hist"].astype(pdt).reshape(-1),
+            out["tmpl"].astype(pdt),
+        ])
+        return packed
+
+    def runner(consensus: np.ndarray, prev_score: float,
+               iters_left: int, prev_iters: int = 0,
+               step_state=()) -> StageResult:
+        tmpl0 = np.zeros(Tmax, np.int8)
+        tmpl0[: len(consensus)] = consensus
+        # prev_score rides as a weak-typed python float: under x64 it
+        # traces as f64, so the stall comparison sees the exact host
+        # score (an early f32 cast broke f64 bit-identity runs)
+        packed = np.asarray(
+            run(jnp.asarray(tmpl0), jnp.int32(len(consensus)),
+                float(prev_score), jnp.int32(iters_left),
+                jnp.int32(prev_iters), step_state)
+        )
+        tlen = int(packed[0])
+        total = float(packed[1])
+        n_rec = int(packed[2])
+        completed = bool(packed[3])
+        o = 4
+        hlen = packed[o : o + H].astype(np.int64)
+        o += H
+        hist = packed[o : o + H * Tmax].reshape(H, Tmax).astype(np.int8)
+        o += H * Tmax
+        tmpl = packed[o : o + Tmax].astype(np.int8)
+        history = [hist[i, : hlen[i]].copy() for i in range(n_rec)]
+        return StageResult(
+            consensus=tmpl[:tlen],
+            score=total,
+            n_iters=n_rec,
+            history=history,
+            completed=completed,
+        )
+
+    return runner

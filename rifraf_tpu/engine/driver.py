@@ -454,6 +454,75 @@ def finish_stage(state: RifrafState, params: RifrafParams) -> None:
         raise RuntimeError(f"invalid stage: {state.stage}")
 
 
+def _try_device_stage(
+    state: RifrafState,
+    params: RifrafParams,
+    old_score: float,
+    iters_left: int,
+    consensus_stages,
+    rng: np.random.Generator,
+) -> Optional["object"]:
+    """Run the remainder of the current stage as ONE device dispatch
+    (engine.device_loop) when eligible; returns the StageResult or None
+    for the host path. Bit-identical to the host loop by construction —
+    the candidate tables, tie order, min-dist filter, and rollback rule
+    all match (tests/test_device_loop.py)."""
+    if params.device_loop == "off":
+        return None
+    if params.device_loop == "auto":
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return None
+    if state.stage not in (Stage.INIT, Stage.REFINE):
+        return None
+    if params.do_alignment_proposals or params.min_dist < 2:
+        return None
+    if params.verbose >= 2:
+        return None
+    full_batch = state.batch_size >= len(state.sequences)
+    stable = full_batch or (state.stage == Stage.INIT and params.batch_fixed)
+    if not stable:
+        return None
+    if state.aligner is None or not bool(state.aligner.fixed.all()):
+        return None
+    # the selection resample would make this iteration (deterministic for
+    # the stable configs; draws no rng)
+    resample(state, params, rng)
+    if not _same_batch(state.aligner, state.batch_seqs):
+        return None
+    runner = state.aligner.stage_runner(
+        len(state.consensus),
+        do_indels=state.stage == Stage.INIT,
+        min_dist=params.min_dist,
+        history_cap=params.max_iters + 1,
+        stop_on_same=full_batch,
+    )
+    if runner is None:
+        return None
+    stage_idx = int(state.stage) - 1
+    res = runner(
+        state.consensus,
+        old_score,
+        iters_left=iters_left,
+        prev_iters=int(state.stage_iterations[stage_idx]),
+    )
+    _log(params, 1,
+         f"device stage {state.stage.name}: {res.n_iters} iterations, "
+         f"score {res.score}")
+    state.consensus = np.asarray(res.consensus, dtype=np.int8)
+    state.score = res.score
+    state.stage_iterations[stage_idx] += res.n_iters
+    consensus_stages[stage_idx].extend(res.history)
+    state.realign_As = True
+    state.realign_Bs = True
+    # the aligner's cached tables/bands describe mid-loop templates
+    state.aligner._realign_key = None
+    if res.completed:
+        finish_stage(state, params)
+    return res
+
+
 def normalize_log_differences(sub_scores, del_scores, ins_scores, state_score):
     """model.jl:720-735."""
     pos_scores = np.hstack([sub_scores, del_scores[:, None]])
@@ -603,11 +672,35 @@ def rifraf(
     old_score = -np.inf
     timers = Timers()
 
-    for iteration in range(1, params.max_iters + 1):
+    iterations_used = 0
+    device_blocked = set()  # stages whose device loop bailed at entry
+    while iterations_used < params.max_iters:
         while state.stage < Stage.SCORE and state.stage not in enabled:
             state.stage = next_stage(state.stage)
         if state.stage == Stage.SCORE:
             break
+        res = None
+        if state.stage not in device_blocked:
+            with timers.time("device_stage"):
+                res = _try_device_stage(
+                    state, params, old_score,
+                    params.max_iters - iterations_used, consensus_stages,
+                    rng,
+                )
+            if res is not None and res.n_iters == 0 and not res.completed:
+                # bailed before finishing one iteration (candidate
+                # overflow / template drift): let the host loop own the
+                # rest of this stage
+                device_blocked.add(state.stage)
+                res = None
+        if res is not None:
+            iterations_used += res.n_iters
+            old_score = res.score
+            if state.converged:
+                break
+            continue
+        iterations_used += 1
+        iteration = iterations_used
         state.stage_iterations[int(state.stage) - 1] += 1
         consensus_stages[int(state.stage) - 1].append(state.consensus.copy())
         _log(params, 1, f"iteration {iteration} : {state.stage.name} : {state.score}")

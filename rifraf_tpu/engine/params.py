@@ -94,12 +94,22 @@ class RifrafParams:
     # XLA-inserted psum over ICI for the score reductions (replaces the
     # reference's process-level pmap, scripts/rifraf.jl:190-191)
     mesh: Optional[object] = None
-    # alignment-fill engine: "auto" (= "xla": the fused scan-kernel step,
-    # the only driver path). "pallas" is rejected — the experimental
-    # on-core column sweep (ops.align_pallas) measured ~100x slower than
-    # the fused XLA step on the available TPU (BASELINE.md) and was
-    # retired from the driver.
+    # alignment-fill engine. "auto": score-and-tables realigns run the
+    # on-core Pallas fill+dense kernels (ops.fill_pallas/dense_pallas)
+    # when eligible (TPU, f32, no mesh, sane read-length spread, fits
+    # HBM — BatchAligner.pallas_eligible), everything else the fused XLA
+    # scan step; "xla" forces the scan path everywhere. The retired
+    # first-generation kernel lives on only as ops.align_pallas.
     backend: str = "auto"
+    # whole-stage device-resident hill-climb (engine.device_loop): run
+    # each eligible INIT/REFINE stage as ONE lax.while_loop dispatch —
+    # one fetch per stage instead of per iteration. "auto": on when the
+    # stage qualifies (stable full batch, do_alignment_proposals=False,
+    # min_dist >= 2, settled bandwidths, verbose < 2) AND the backend is
+    # a real TPU (where the per-iteration fetch costs ~100 ms); "on":
+    # also on CPU (the loop is backend-agnostic; used by equality
+    # tests); "off": never.
+    device_loop: str = "auto"
 
 
 def resolve_dtype(dtype) -> np.dtype:
@@ -117,13 +127,26 @@ def validate_backend(backend: str, dtype, mesh) -> None:
     (check_params) and on direct BatchAligner construction so an explicit
     backend request can never silently fall back."""
     if backend == "pallas":
-        raise ValueError(
-            "backend='pallas' was retired from the driver: the sequential-"
-            "grid Pallas fill measured ~100x slower than the fused XLA "
-            "step on the available TPU and degraded subsequent XLA "
-            "launches (BASELINE.md). The oracle-verified kernels remain "
-            "available directly in rifraf_tpu.ops.align_pallas."
-        )
+        # an explicit request asserts the on-core path is available;
+        # "auto" falls back silently instead
+        import jax
+
+        if mesh is not None:
+            raise ValueError(
+                "backend='pallas' does not support a mesh: the sharded "
+                "read axis runs on the XLA scan engines"
+            )
+        if resolve_dtype(dtype) != np.float32:
+            raise ValueError(
+                "backend='pallas' requires float32 (the on-core kernels "
+                "are f32; run with x64 disabled or dtype='float32')"
+            )
+        if jax.default_backend() != "tpu":
+            raise ValueError(
+                "backend='pallas' requires a TPU backend; on "
+                f"{jax.default_backend()!r} use 'auto' or 'xla'"
+            )
+        return
     if backend not in ("auto", "xla"):
         raise ValueError(f"unknown backend: {backend!r}")
 
@@ -161,4 +184,6 @@ def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> No
         raise ValueError("batch_mult must be between 0.0 and 1.0")
     if not (0.0 <= params.batch_threshold <= 1.0):
         raise ValueError("batch_threshold must be between 0.0 and 1.0")
+    if params.device_loop not in ("auto", "on", "off"):
+        raise ValueError(f"unknown device_loop: {params.device_loop!r}")
     validate_backend(params.backend, params.dtype, params.mesh)

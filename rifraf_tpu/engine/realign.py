@@ -20,6 +20,7 @@ recompiles, once per bucket) only when a band no longer fits.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -180,6 +181,13 @@ class BatchAligner:
         self._total = None
         self.edits_seen = None
         self._realign_key = None  # memo key of the last completed realign
+        # Pallas-path state (built lazily; template-independent per batch)
+        self._fill_bufs = None
+        self._r_unique = tuple(
+            sorted({int(v) for v in
+                    self._lengths_host - self._lengths_host.min()})
+        )
+        self._stage_runners = {}
 
     def _padded_template(self, consensus: np.ndarray) -> np.ndarray:
         T = _bucket(len(consensus) + 1, self.len_bucket)
@@ -206,6 +214,189 @@ class BatchAligner:
                      "sharded bandwidth cache is stale")
             bw = self._bw_dev
         return self.batch._replace(bandwidth=bw)
+
+    # --- Pallas fast path -------------------------------------------------
+    def _pallas_K(self, tlen: int, margin: int = 0) -> int:
+        """Uniform-frame band height for the current bandwidths (+margin
+        template-length drift headroom), rounded to the f32 sublane tile."""
+        bw = self.bandwidths.astype(np.int64)
+        lengths = self._lengths_host.astype(np.int64)
+        off = np.maximum(tlen - lengths, 0) + bw
+        nd = 2 * bw + np.abs(lengths - tlen) + 1
+        K = int((off.max() - off + nd).max()) + margin
+        return ((K + 7) // 8) * 8
+
+    def pallas_eligible(self, tlen: int, want_moves: bool,
+                        want_stats: bool) -> bool:
+        """Policy: the Pallas fill+dense path serves score-and-tables
+        realigns on a real TPU. Moves/stats (SCORE-stage tracebacks,
+        bandwidth adaptation, alignment proposals) stay on the XLA scan
+        engine, as do f64 exactness runs, sharded meshes (the read axis
+        lives across chips), pathological read-length spreads (the
+        uniform frame's K would blow up — see fill_pallas), and
+        working sets past the HBM budget (the XLA path read-chunks)."""
+        if self.backend == "xla" or want_moves or want_stats:
+            return False
+        if self.dtype != np.float32 or self.mesh is not None:
+            return False
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return False
+        forced = self.backend == "pallas"
+        K_uni = self._pallas_K(tlen)
+        K_xla = self._K(tlen)
+        reason = None
+        if K_uni > K_xla + 64:
+            reason = (
+                f"uniform-frame band height {K_uni} blows up vs {K_xla} "
+                "(pathological read-length spread)"
+            )
+        elif len(self._r_unique) > 24:
+            reason = (
+                f"{len(self._r_unique)} distinct read-length residuals "
+                "(backward alignment would compile too many rolls)"
+            )
+        else:
+            Npad = _bucket(self.batch.n_reads, 128)
+            T1p = _bucket(_bucket(tlen + 1, self.len_bucket) + 1, 64)
+            if 4 * T1p * K_uni * Npad * 4 > self.hbm_budget:
+                reason = "band working set exceeds the HBM budget"
+        if reason is None:
+            return True
+        if forced:
+            raise RuntimeError(f"backend='pallas' unavailable: {reason}")
+        return False
+
+    def _ensure_fill_bufs(self):
+        if self._fill_bufs is None:
+            import jax
+
+            from ..ops.fill_pallas import build_fill_buffers
+
+            import jax.numpy as jnp
+
+            Npad = _bucket(self.batch.n_reads, 128)
+            self._fill_bufs = jax.block_until_ready(build_fill_buffers(
+                self.batch.seq, self.batch.match, self.batch.mismatch,
+                self.batch.ins, self.batch.dels,
+                jnp.asarray(self._lengths_host), Npad,
+            ))
+        return self._fill_bufs
+
+    def _realign_pallas(self, t: np.ndarray, tlen: int) -> None:
+        """The no-moves/no-stats realign on the Pallas engines: one
+        dispatch, one packed fetch (same contract as the XLA branch)."""
+        import jax.numpy as jnp
+
+        from ..ops import align_jax
+        from ..ops.dense_pallas import (
+            fused_step_pallas,
+            pack_layout_pallas,
+            pick_dense_cols,
+        )
+
+        T = len(t)
+        T1 = T + 1
+        T1p = _bucket(T1, 64)
+        K = self._pallas_K(tlen)
+        C = pick_dense_cols(T1p, K)
+        bufs = self._ensure_fill_bufs()
+        batch = self._current_batch()
+        geom = align_jax.batch_geometry(batch, tlen)
+        weights = jnp.ones(self.batch.n_reads, dtype=jnp.float32)
+        self.n_forward_fills += 1
+        with self.timers.time("fused_dispatch"):
+            packed = fused_step_pallas(
+                jnp.asarray(t, jnp.int8), jnp.int32(tlen), bufs, geom,
+                weights, K, T1p, C, self._r_unique,
+            )
+        with self.timers.time("packed_fetch"):
+            ph = np.asarray(packed)
+        Npad = bufs.seq_T.shape[1]
+        lay = pack_layout_pallas(Npad, T1p)
+        self._total = float(ph[0])
+        self.scores = ph[slice(*lay["scores"])][: self.batch.n_reads]
+        self._tables_host = (
+            ph[slice(*lay["sub"])].reshape(T1p, 4)[:T1],
+            ph[slice(*lay["ins"])].reshape(T1p, 4)[:T1],
+            ph[slice(*lay["del"])][:T1],
+        )
+        self.A_bands = None
+        self.B_bands = None
+        self.moves = None
+        self.geom = geom
+        self.tracebacks = None
+        self.edits_seen = None
+
+    # --- device-resident stage loop ---------------------------------------
+    def stage_runner(self, tlen0: int, do_indels: bool, min_dist: int,
+                     history_cap: int, stop_on_same: bool):
+        """Jitted whole-stage hill-climb runner (engine.device_loop) over
+        this batch, or None when no step engine fits. The compiled
+        while-loop is cached at module level by static shape config
+        (_pallas_stage_runner/_xla_stage_runner) — a fresh aligner with
+        the same shapes reuses it; this method binds the batch's device
+        state and returns a (consensus, prev_score, iters_left,
+        prev_iters) -> StageResult callable."""
+        import jax.numpy as jnp
+
+        from .device_loop import MAX_DRIFT
+
+        if not bool(self.fixed.all()) or self.mesh is not None:
+            return None
+        Tmax = _bucket(tlen0 + 1, self.len_bucket)
+        key = (Tmax, do_indels, min_dist, history_cap, stop_on_same)
+        if key in self._stage_runners:
+            return self._stage_runners[key]
+
+        use_pallas = self.pallas_eligible(tlen0, False, False)
+        n_reads = self.batch.n_reads
+        T1 = Tmax + 1
+        T1p = _bucket(T1, 64)
+        bw_dev = jnp.asarray(self.bandwidths)
+        lengths_dev = jnp.asarray(self._lengths_host)
+
+        if use_pallas:
+            from ..ops.dense_pallas import pick_dense_cols
+
+            # drift headroom: the template may shrink/grow inside the loop
+            K = self._pallas_K(tlen0, margin=MAX_DRIFT)
+            C = pick_dense_cols(T1p, K)
+            weights = jnp.ones(n_reads, dtype=jnp.float32)
+            base = _pallas_stage_runner(
+                K, T1p, C, self._r_unique, do_indels, min_dist,
+                history_cap, Tmax, stop_on_same,
+            )
+            state = (self._ensure_fill_bufs(), lengths_dev, bw_dev, weights)
+        else:
+            from ..ops import align_jax
+
+            batch = self._current_batch()
+            K = _bucket(
+                align_jax.band_height(
+                    batch._replace(bandwidth=self.bandwidths), tlen0
+                ) + MAX_DRIFT,
+                8,
+            )
+            chunk = _pick_read_chunk(n_reads, K, T1, self.hbm_budget)
+            weights = jnp.ones(n_reads, dtype=self.dtype)
+            base = _xla_stage_runner(
+                K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
+                history_cap, stop_on_same,
+            )
+            state = (
+                (batch.seq, batch.match, batch.mismatch, batch.ins,
+                 batch.dels),
+                lengths_dev, bw_dev, weights,
+            )
+
+        def runner(consensus, prev_score, iters_left, prev_iters=0):
+            return base(consensus, prev_score, iters_left, prev_iters,
+                        step_state=state)
+
+        self._stage_runners[key] = runner
+        return runner
 
     # --- alignment --------------------------------------------------------
     def realign(
@@ -257,6 +448,12 @@ class BatchAligner:
         if key == self._realign_key and bool(self.fixed.all()):
             return
         self._tlen = tlen
+        if bool(self.fixed.all()) and self.pallas_eligible(
+            tlen, want_moves, want_stats
+        ):
+            self._realign_pallas(t, tlen)
+            self._realign_key = key
+            return
         T1 = len(t) + 1
         weights = self._weights_dev
         if weights is None:
@@ -440,6 +637,58 @@ class BatchAligner:
         for k, r in enumerate(self.reads):
             r.bandwidth = int(self.bandwidths[k])
             r.bandwidth_fixed = bool(self.fixed[k])
+
+
+@functools.lru_cache(maxsize=64)
+def _pallas_stage_runner(K, T1p, C, r_unique, do_indels, min_dist,
+                         history_cap, Tmax, stop_on_same):
+    """Compiled device stage loop over the Pallas fill+dense step, shared
+    across aligners of identical shape config. step_state =
+    (FillBuffers, lengths, bandwidths, weights)."""
+    from ..ops.align_jax import BandGeometry
+    from ..ops.dense_pallas import fused_tables_pallas
+    from .device_loop import make_stage_runner
+
+    def step_fn(tmpl, tlen, s):
+        bufs, lengths, bw, weights = s
+        geom = BandGeometry.make(lengths, tlen, bw)
+        total, _scores, sub_t, ins_t, del_t = fused_tables_pallas(
+            tmpl, tlen, bufs, geom, weights, K, T1p, C, r_unique
+        )
+        return total, sub_t, ins_t, del_t
+
+    return make_stage_runner(
+        step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
+                      history_cap, stop_on_same):
+    """Compiled device stage loop over the fused XLA scan step (any
+    backend / f64 exactness runs). step_state = ((seq, match, mismatch,
+    ins, dels), lengths, bandwidths, weights)."""
+    from ..ops.align_jax import BandGeometry
+    from ..ops.fused import fused_step_full, pack_layout
+    from .device_loop import make_stage_runner
+
+    lay = pack_layout(n_reads, T1, False)
+
+    def step_fn(tmpl, tlen, s):
+        (seq, match, mismatch, ins, dels), lengths, bw, weights = s
+        geom = BandGeometry.make(lengths, tlen, bw)
+        _, _, _, packed = fused_step_full(
+            tmpl[:Tmax], seq, match, mismatch, ins, dels, geom, weights,
+            K, False, False, chunk,
+        )
+        sub_t = packed[slice(*lay["sub"])].reshape(T1, 4)
+        ins_t = packed[slice(*lay["ins"])].reshape(T1, 4)
+        del_t = packed[slice(*lay["del"])]
+        return packed[0], sub_t, ins_t, del_t
+
+    return make_stage_runner(
+        step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same
+    )
 
 
 class RefAligner:

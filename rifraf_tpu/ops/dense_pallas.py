@@ -1,0 +1,466 @@
+"""On-core Pallas dense all-edits scorer over uniform-frame bands.
+
+Companion to ops.fill_pallas: scores EVERY single-base edit (the
+reference's O(band) rescoring trick, /root/reference/src/model.jl:242-285
++ util.jl:40-48, densified over all positions as in ops.proposal_dense)
+directly from the fill kernel's native band layout — flat [T1p * K,
+lanes] with reads on lanes — so the bands never get transposed,
+flipped, or fetched. The XLA dense sweep costs ~135 ms at 1 kb x 256 on
+the available TPU and the band-layout fix-ups another ~45 ms (round-4
+profile); this kernel plus the in-jit backward alignment replaces both.
+
+Backward-band alignment
+-----------------------
+The backward band is computed as the forward DP of the reversed problem
+(fill_pallas). Its raw output ``Brev`` relates to the backward band by
+``B[d, j] = Brev[S_k - d, tlen - j]`` with ``S_k = slen_k - tlen +
+2*OFF``. The column remap is read-independent (flip + uniform roll);
+the row remap splits into a uniform roll and per-lane residuals
+``r_k = slen_k - min(slen)`` that are STATIC per batch — so
+``align_backward`` does the whole remap with whole-array flips/rolls
+plus one masked roll per DISTINCT residual (a handful at realistic
+read-length spreads), all fused by XLA inside the surrounding jit.
+
+The dense kernel
+----------------
+Grid (read_blocks, column_blocks); per column j of a block, in VMEM:
+
+- deletions: ``max_d(A[d, j] + B[d-1, j+1])`` (summax join, util.jl:40-48);
+- substitutions at j: one recomputed column in frame j+1 from
+  (A[:, j], A[d+1, j]) per base, joined with B[:, j+1];
+- insertions after j: one recomputed column in frame j from
+  (A[d-1, j], A[:, j]) per base, joined with B[:, j];
+
+emitting PER-LANE join maxima as a [16, 128] tile per column (rows:
+0 deletion, 1-4 substitution bases, 5-8 insertion bases, 9-15 zero
+padding); the read-weighted reduction over lanes happens in XLA on
+these small outputs. Row-range masks use each read's OWN band limits
+(model.jl:263's row_range), not the uniform frame's — exactness vs the
+reference is pinned by the oracle tests against ops.proposal_dense.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .align_jax import BandGeometry
+from .fill_pallas import (
+    LANES,
+    NEG_INF,
+    FillBuffers,
+    _cumop,
+    _pad_lanes,
+    fill_uniform,
+)
+
+ROWS = 16  # padded per-column output rows (9 used)
+
+
+def align_backward(Brev_flat, tlen, OFF, slen, r_unique, K: int, T1p: int):
+    """Map the raw reversed-problem band to backward-band layout, in the
+    fill kernel's flat [T1p * K, Npad] layout.
+
+    ``B[d, j] = Brev[S_k - d, tlen - j]``; ``r_unique`` is the static
+    tuple of distinct ``slen_k - slen_min`` residuals (host-known per
+    batch; padding lanes carry slen 0 and are excluded from the min —
+    their content is garbage, masked by consumers). Rolled-in cells are
+    NOT re-masked: every consumer joins them against an out-of-band A
+    cell (NEG_INF sentinel) or masks by row range.
+    """
+    Npad = Brev_flat.shape[1]
+    B3 = Brev_flat.reshape(T1p, K, Npad)
+    # columns: want column j to hold Brev column (tlen - j)
+    B3 = B3[::-1]  # column j now holds Brev column T1p - 1 - j
+    B3 = jnp.roll(B3, tlen + 1 - T1p, axis=0)
+    # rows: want row d to hold Brev row (S_k - d)
+    B3 = B3[:, ::-1]  # row d now holds Brev row K - 1 - d
+    slen_min = jnp.min(jnp.where(slen > 0, slen, jnp.int32(2**30)))
+    S_min = slen_min - tlen + 2 * OFF
+    B3 = jnp.roll(B3, S_min - (K - 1), axis=1)  # uniform part of S_k
+    if len(r_unique) > 1:
+        r_lane = (slen - slen_min)[None, None, :]
+        out = B3
+        for r in r_unique:
+            if r == 0:
+                continue
+            out = jnp.where(r_lane == r, jnp.roll(B3, r, axis=1), out)
+        B3 = out
+    return B3.reshape(T1p * K, Npad)
+
+
+def block_backward_halo(Bal_flat, K: int, T1p: int, C: int):
+    """[T1p*K, Npad] -> [n_steps, (C+1)*K, Npad]: block jb holds columns
+    [jb*C, jb*C + C] (one halo column; the last block's halo is padding).
+    BlockSpec tilings cannot overlap, so the halo is materialized."""
+    Npad = Bal_flat.shape[1]
+    pad = jnp.full((K, Npad), NEG_INF, Bal_flat.dtype)
+    Bp = jnp.concatenate([Bal_flat, pad], axis=0)
+    n_steps = T1p // C
+    return jnp.stack(
+        [
+            jax.lax.dynamic_slice_in_dim(Bp, jb * C * K, (C + 1) * K, axis=0)
+            for jb in range(n_steps)
+        ]
+    )
+
+
+def backward_halo_blocks(Brev_flat, tlen, OFF, slen, r_unique, K: int,
+                         T1p: int, C: int, lane0: int = 0):
+    """align_backward + block_backward_halo in ONE memory-lean pass.
+
+    Produces the halo-blocked backward band [n_steps, (C+1)*K, Npad]
+    directly from the raw reversed-problem band, one output block at a
+    time (lax.map) so peak HBM stays O(block) instead of the full-band
+    copy per flip/roll that the naive chain materializes (measured OOM
+    at 2048 reads x 1 kb: ~17 roll intermediates of ~1 GB each).
+
+    ``Brev_flat`` may carry extra lane blocks (e.g. the fill kernel's
+    combined [.., 2*Npad] output); ``lane0`` selects where the reversed
+    stream's lanes start. Output block jb holds B columns
+    [jb*C, jb*C + C] with B[d, j] = Brev[S_k - d, tlen - j]; cells with
+    j > tlen or rolled-in rows are garbage by the same contract as
+    align_backward (consumers mask by row range / join against A's
+    NEG sentinel)."""
+    Npad = slen.shape[0]
+    n_steps = T1p // C
+    B3 = Brev_flat.reshape(T1p, K, -1)
+    tlen = jnp.asarray(tlen, jnp.int32)
+    slen_min = jnp.min(jnp.where(slen > 0, slen, jnp.int32(2**30)))
+    S_min = slen_min - tlen + 2 * OFF
+    r_lane = (slen - slen_min)[None, None, :]
+
+    def one_block(jb):
+        # B columns [jb*C, jb*C + C] = Brev columns [tlen-jb*C-C, tlen-jb*C]
+        start_raw = tlen - jb * C - C
+        start = jnp.maximum(start_raw, 0)
+        shift = start - start_raw  # >0 when clamped (j near/past tlen)
+        blk = jax.lax.dynamic_slice(
+            B3, (start, jnp.int32(0), jnp.int32(lane0)), (C + 1, K, Npad)
+        )
+        blk = blk[::-1]  # ascending B-column order
+        # clamped windows are shifted; realign (garbage rotates among
+        # garbage columns only)
+        blk = jnp.roll(blk, -shift, axis=0)
+        # rows: want row d = Brev row S_k - d
+        blk = blk[:, ::-1]  # row d holds Brev row K-1-d
+        blk = jnp.roll(blk, S_min - (K - 1), axis=1)
+        if len(r_unique) > 1:
+            out = blk
+            for r in r_unique:
+                if r == 0:
+                    continue
+                out = jnp.where(r_lane == r, jnp.roll(blk, r, axis=1), out)
+            blk = out
+        return blk.reshape((C + 1) * K, Npad)
+
+    return jax.lax.map(one_block, jnp.arange(n_steps, dtype=jnp.int32))
+
+
+def _dense_kernel(
+    tlen_ref,  # SMEM [1, 1]
+    off_ref,  # SMEM [1, 1] uniform OFF
+    slen_ref,  # [1, 1, 128] int32
+    roff_ref,  # [1, 1, 128] int32 per-read band offset (geom.offset)
+    bw_ref,  # [1, 1, 128] int32 per-read bandwidth
+    a_ref,  # [1, C * K, 128] forward band columns of this block
+    bh_ref,  # [1, (C + 1) * K, 128] backward band columns j .. j+C
+    mt_ref,  # [1, CB, 128] blocked tables (fill_pallas layout)
+    mm_ref,
+    gi_ref,
+    dl_ref,
+    sq_ref,
+    out_ref,  # VMEM [1, 1, C * ROWS, 128] per-lane join maxima
+    *,
+    K: int,
+    C: int,
+):
+    tlen = tlen_ref[0, 0]
+    OFF = off_ref[0, 0]
+    jb = pl.program_id(1)
+
+    slen = slen_ref[0, 0, :]
+    roff = roff_ref[0, 0, :]
+    bw = bw_ref[0, 0, :]
+    d = jax.lax.broadcasted_iota(jnp.int32, (K, LANES), 0)
+    neg = jnp.full((K, LANES), NEG_INF, jnp.float32)
+    zero16 = jnp.full((ROWS - 9, LANES), 0.0, jnp.float32)
+    v_off = jnp.maximum(slen - tlen, 0)
+
+    for c in range(C):
+        j = jb * C + c
+        A_j = a_ref[0, c * K : (c + 1) * K, :]
+        B_j = bh_ref[0, c * K : (c + 1) * K, :]
+        B_n = bh_ref[0, (c + 1) * K : (c + 2) * K, :]
+
+        # A[d+1, j], A[d-1, j], B[d-1, j+1]
+        A_up = pltpu.roll(A_j, K - 1, axis=0)
+        A_up = jnp.where(d == K - 1, neg, A_up)
+        A_dn = pltpu.roll(A_j, 1, axis=0)
+        A_dn = jnp.where(d == 0, neg, A_dn)
+        B_n_dn = pltpu.roll(B_n, 1, axis=0)
+        B_n_dn = jnp.where(d == 0, neg, B_n_dn)
+
+        # row-range of the recomputed column (model.jl:263): the read's
+        # own band limits at column jc = min(j+1, tlen)
+        jc = jnp.minimum(j + 1, tlen)
+        rmin = jnp.maximum(0, jc - roff)
+        rmax = jnp.minimum(jc + v_off + bw, slen)
+
+        dele = jnp.max(A_j + B_n_dn, axis=0, keepdims=True)  # [1, LANES]
+
+        def edit_scores(i, sq, mt, mm, gi, dl, m_src, d_src, B_join):
+            valid = (i >= rmin[None, :]) & (i <= rmax[None, :])
+            dcand = d_src + dl
+            g = jnp.where((i >= 1) & valid, gi, 0.0)
+            G = _cumop(g, lambda a, b: a + b, K)
+            outs = []
+            for b in range(4):
+                msc = jnp.where(sq == b, mt, mm)
+                mcand = jnp.where(i >= 1, m_src + msc, neg)
+                cand = jnp.where(valid, jnp.maximum(mcand, dcand), neg)
+                NC = G + _cumop(cand - G, jnp.maximum, K)
+                NC = jnp.where(valid, NC, neg)
+                outs.append(jnp.max(NC + B_join, axis=0, keepdims=True))
+            return outs  # 4 x [1, LANES]
+
+        # substitutions at j: frame j+1 -> table window = block rows
+        # [c+1, c+1+K); insertions after j: frame j -> rows [c, c+K)
+        subs = edit_scores(
+            d + (j + 1 - OFF),
+            sq_ref[0, c + 1 : c + 1 + K, :],
+            mt_ref[0, c + 1 : c + 1 + K, :],
+            mm_ref[0, c + 1 : c + 1 + K, :],
+            gi_ref[0, c + 1 : c + 1 + K, :],
+            dl_ref[0, c + 1 : c + 1 + K, :],
+            A_j, A_up, B_n,
+        )
+        insr = edit_scores(
+            d + (j - OFF),
+            sq_ref[0, c : c + K, :],
+            mt_ref[0, c : c + K, :],
+            mm_ref[0, c : c + K, :],
+            gi_ref[0, c : c + K, :],
+            dl_ref[0, c : c + K, :],
+            A_dn, A_j, B_j,
+        )
+        out_ref[0, 0, c * ROWS : (c + 1) * ROWS, :] = jnp.concatenate(
+            [dele] + subs + insr + [zero16], axis=0
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("K", "T1p", "C", "interpret"))
+def dense_call(
+    tlen_s,  # [1, 1] int32
+    off_s,  # [1, 1] int32
+    meta,  # [3, Npad] int32: slen, roff, bw
+    A_flat,  # [T1p * K, Npad] forward band (uniform frame, flat)
+    Bh,  # [n_steps, (C + 1) * K, Npad] halo-blocked backward band
+    mt, mm, gi, dl, sq,  # [NSTEPS, CB, Npad] blocked tables
+    K: int,
+    T1p: int,
+    C: int,
+    interpret: bool = False,
+):
+    # lane count from the metadata, NOT the band: A_flat may carry extra
+    # lane blocks (the fill kernel's combined fwd+rev output) that the
+    # lane-block index simply never touches — avoiding a ~1 GB copy
+    Npad = meta.shape[1]
+    NB = Npad // LANES
+    n_steps = T1p // C
+    CB = mt.shape[1]
+
+    grid = (NB, n_steps)
+
+    def tab_spec():
+        return pl.BlockSpec(
+            (1, CB, LANES), lambda nb, jb: (jb, 0, nb),
+            memory_space=pltpu.VMEM,
+        )
+
+    def lane_spec():
+        return pl.BlockSpec(
+            (1, 1, LANES), lambda nb, jb: (0, 0, nb),
+            memory_space=pltpu.VMEM,
+        )
+
+    out = pl.pallas_call(
+        functools.partial(_dense_kernel, K=K, C=C),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+            lane_spec(),  # slen
+            lane_spec(),  # roff
+            lane_spec(),  # bw
+            pl.BlockSpec(
+                (1, C * K, LANES), lambda nb, jb: (0, jb, nb),
+                memory_space=pltpu.VMEM,
+            ),  # A block
+            pl.BlockSpec(
+                (1, (C + 1) * K, LANES), lambda nb, jb: (jb, 0, nb),
+                memory_space=pltpu.VMEM,
+            ),  # halo-blocked B
+            tab_spec(),
+            tab_spec(),
+            tab_spec(),
+            tab_spec(),
+            tab_spec(),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, C * ROWS, LANES), lambda nb, jb: (nb, jb, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (NB, n_steps, C * ROWS, LANES), jnp.float32
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        tlen_s, off_s,
+        meta[0][None, None], meta[1][None, None], meta[2][None, None],
+        A_flat[None],
+        Bh,
+        mt, mm, gi, dl, sq,
+    )
+    # [NB, n_steps, C*ROWS, 128] -> per-lane tables [T1p, ROWS, Npad]
+    out = out.reshape(NB, n_steps, C, ROWS, LANES)
+    out = out.transpose(1, 2, 3, 0, 4).reshape(T1p, ROWS, NB * LANES)
+    return out
+
+
+def dense_tables_pallas(
+    tlen_s, off_s, meta, A_flat, Bh, tabs, weights, K, T1p, C,
+    interpret=False,
+):
+    """Weighted batch-total score tables from the dense kernel.
+
+    Returns (sub [T1p, 4], ins [T1p, 4], del [T1p]) — matching
+    ops.proposal_dense.score_all_edits's contract (positions >= tlen
+    are garbage)."""
+    mt, mm, gi, dl, sq = tabs
+    per_lane = dense_call(
+        tlen_s, off_s, meta, A_flat, Bh, mt, mm, gi, dl, sq,
+        K=K, T1p=T1p, C=C, interpret=interpret,
+    )
+    w = weights[None, None, :]
+    tables = jnp.sum(jnp.where(w > 0, per_lane, 0.0) * w, axis=2)
+    return tables[:, 1:5], tables[:, 5:9], tables[:, 0]
+
+
+def fused_tables_pallas(
+    template,  # int8 [Tmax] padded template
+    tlen,  # int32 true length
+    bufs: FillBuffers,
+    geom: BandGeometry,
+    weights,  # [N] f32 (padding lanes 0)
+    K: int,
+    T1p: int,
+    C: int,
+    r_unique: Tuple[int, ...],
+    interpret: bool = False,
+):
+    """One hill-climb iteration's device work, all-Pallas: forward +
+    backward fills (one launch), backward alignment, dense all-edits
+    tables — the Pallas counterpart of ops.fused.fused_step_full's
+    no-stats path. Returns device arrays
+    (total, scores [Npad], sub [T1p, 4], ins [T1p, 4], del [T1p])."""
+    from . import fill_pallas
+
+    Npad = bufs.seq_T.shape[1]
+    NB = Npad // LANES
+    p = fill_pallas.prepare_fill(
+        template, tlen, bufs, geom, K, T1p, C, with_backward=True
+    )
+    band_flat, scores2 = fill_pallas._fill_call(
+        p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
+        K=K, T1p=T1p, NBLK=2 * NB, C=C, interpret=interpret,
+    )
+    scores = scores2[0, :Npad]
+
+    # the backward stream occupies lane blocks [NB, 2NB) of band_flat;
+    # the dense kernel reads the forward lanes of band_flat in place
+    Bh = backward_halo_blocks(
+        band_flat, jnp.asarray(tlen, jnp.int32), p["OFF"], bufs.lengths,
+        r_unique, K, T1p, C, lane0=Npad,
+    )
+    A_flat = band_flat
+
+    w = _pad_lanes(weights.astype(jnp.float32), Npad)
+    meta3 = jnp.stack([
+        bufs.lengths,
+        _pad_lanes(geom.offset.astype(jnp.int32), Npad),
+        _pad_lanes(geom.bandwidth.astype(jnp.int32), Npad),
+    ])
+    sub_t, ins_t, del_t = dense_tables_pallas(
+        p["tlen_s"], p["off_s"], meta3, A_flat, Bh, p["fwd_tabs"], w,
+        K, T1p, C, interpret=interpret,
+    )
+    total = jnp.sum(jnp.where(w > 0, scores, 0.0) * w)
+    return total, scores, sub_t, ins_t, del_t
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "T1p", "C", "r_unique", "interpret")
+)
+def fused_step_pallas(
+    template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
+    K: int, T1p: int, C: int, r_unique: Tuple[int, ...],
+    interpret: bool = False,
+):
+    """Packed-single-fetch wrapper of fused_tables_pallas (layout:
+    pack_layout_pallas)."""
+    total, scores, sub_t, ins_t, del_t = fused_tables_pallas(
+        template, tlen, bufs, geom, weights, K, T1p, C, r_unique,
+        interpret=interpret,
+    )
+    return jnp.concatenate([
+        total[None],
+        scores,
+        sub_t.reshape(-1),
+        ins_t.reshape(-1),
+        del_t,
+    ])
+
+
+def pack_layout_pallas(Npad: int, T1p: int):
+    """Slice map of fused_step_pallas's packed array."""
+    out = {}
+    o = 0
+
+    def take(name, size):
+        nonlocal o
+        out[name] = (o, o + size)
+        o += size
+
+    take("total", 1)
+    take("scores", Npad)
+    take("sub", T1p * 4)
+    take("ins", T1p * 4)
+    take("del", T1p)
+    return out
+
+
+def pick_dense_cols(T1p: int, K: int, vmem_budget: int = 9 << 20) -> int:
+    """Columns per dense grid step: largest power-of-two divisor of T1p
+    whose double-buffered working set (A block C*K + B halo (C+1)*K +
+    5 tables (C+K) + out C*ROWS, all [.., 128] f32) fits the budget.
+    Capped at T1p // 2 so the backward halo slice (C + 1 columns) always
+    fits inside the band."""
+    best = 1
+    c = 1
+    while c <= min(T1p // 2, 256):
+        if T1p % c == 0:
+            rows = c * K + (c + 1) * K + 5 * (c + K) + c * ROWS
+            if 2 * 128 * 4 * rows <= vmem_budget:
+                best = c
+        c *= 2
+    return best

@@ -374,31 +374,22 @@ def build_fill_buffers(seq, match, mismatch, ins, dels, lengths,
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("K", "T1p", "C", "with_backward", "interpret")
-)
-def fill_uniform(
+def prepare_fill(
     template,  # int8 [Tmax] padded template
     tlen,  # int32 true length
     bufs: FillBuffers,
-    geom: BandGeometry,  # per-read (offset may exceed lanes: padded below)
+    geom: BandGeometry,
     K: int,
     T1p: int,
-    C: int = 0,
+    C: int,
     with_backward: bool = True,
-    interpret: bool = False,
 ):
-    """Pallas banded fill in the uniform frame.
-
-    Returns (A [N, K, T1p], Brev or None, scores [N], OFF) where A is the
-    forward band, Brev the RAW reversed-problem forward band (flip to
-    backward layout with flip_reversed_uniform), and scores[k] =
-    A[dend_k, tlen]. N = lane count (callers slice off padding lanes).
-    """
+    """Build every _fill_call input: frame scalars, per-lane metadata,
+    template column tables, and the halo-blocked score tables for the
+    forward (and optionally reversed) stream. Returns a dict; the
+    forward-stream blocked tables ride along for reuse by the dense
+    kernel (ops.dense_pallas), which consumes the identical layout."""
     Npad = bufs.seq_T.shape[1]
-    NB = Npad // LANES
-    if C <= 0:
-        C = _pick_cols(T1p, K)
     n_steps = T1p // C
     CB = C + K
 
@@ -470,17 +461,51 @@ def fill_uniform(
         meta = jnp.stack(
             [jnp.concatenate([m, m])[None] for m in meta_rows]
         )
-        NBLK = 2 * NB
     else:
         mt, mm, gi, dl, sq = f_mt, f_mm, f_gi, f_dl, f_sq
         t_cols = tpl[None]
         meta = jnp.stack([m[None] for m in meta_rows])
-        NBLK = NB
 
-    tlen_s = jnp.reshape(tlen.astype(jnp.int32), (1, 1))
-    off_s = jnp.reshape(OFF, (1, 1))
+    return {
+        "tlen_s": jnp.reshape(tlen, (1, 1)),
+        "off_s": jnp.reshape(OFF, (1, 1)),
+        "OFF": OFF,
+        "t_cols": t_cols,
+        "meta": meta,
+        "tabs": (mt, mm, gi, dl, sq),
+        "fwd_tabs": (f_mt, f_mm, f_gi, f_dl, f_sq),
+    }
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "T1p", "C", "with_backward", "interpret")
+)
+def fill_uniform(
+    template,  # int8 [Tmax] padded template
+    tlen,  # int32 true length
+    bufs: FillBuffers,
+    geom: BandGeometry,  # per-read (offset may exceed lanes: padded below)
+    K: int,
+    T1p: int,
+    C: int = 0,
+    with_backward: bool = True,
+    interpret: bool = False,
+):
+    """Pallas banded fill in the uniform frame.
+
+    Returns (A [N, K, T1p], Brev or None, scores [N], OFF) where A is the
+    forward band, Brev the RAW reversed-problem forward band (flip to
+    backward layout with flip_reversed_uniform), and scores[k] =
+    A[dend_k, tlen]. N = lane count (callers slice off padding lanes).
+    """
+    Npad = bufs.seq_T.shape[1]
+    NB = Npad // LANES
+    if C <= 0:
+        C = _pick_cols(T1p, K)
+    p = prepare_fill(template, tlen, bufs, geom, K, T1p, C, with_backward)
+    NBLK = 2 * NB if with_backward else NB
     band_flat, scores = _fill_call(
-        tlen_s, off_s, t_cols, meta, mt, mm, gi, dl, sq,
+        p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
         K=K, T1p=T1p, NBLK=NBLK, C=C, interpret=interpret,
     )
     # [n_steps*C*K, NBLK*128] -> [T1p, K, NBLK*128] -> [lanes, K, T1p]
@@ -488,8 +513,8 @@ def fill_uniform(
     A = band[:Npad]
     if with_backward:
         Brev = band[Npad:]
-        return A, Brev, scores[0, :Npad], OFF
-    return A, None, scores[0, :Npad], OFF
+        return A, Brev, scores[0, :Npad], p["OFF"]
+    return A, None, scores[0, :Npad], p["OFF"]
 
 
 @functools.partial(jax.jit, static_argnames=("K",))

@@ -63,9 +63,11 @@ def test_pallas_backward_matches_xla():
     assert (bp[~np.isfinite(bx)] < -1e30).all()
 
 
-def test_backend_pallas_rejected():
-    """backend="pallas" was retired from the driver (BASELINE.md): an
-    explicit request must fail loudly, never silently run XLA."""
+def test_backend_pallas_unavailable_off_tpu():
+    """backend="pallas" (the second-generation ops.fill_pallas /
+    ops.dense_pallas engines) asserts availability: off-TPU an explicit
+    request must fail loudly, never silently run XLA. (This suite runs
+    on the forced-CPU backend.)"""
     import pytest
 
     from rifraf_tpu.engine.realign import BatchAligner
@@ -74,5 +76,5 @@ def test_backend_pallas_rejected():
     read = make_read_scores(
         np.array([0, 1, 2, 3], np.int8), np.full(4, -2.0), 3, SCORES
     )
-    with pytest.raises(ValueError, match="retired"):
+    with pytest.raises(ValueError, match="requires a TPU"):
         BatchAligner([read], dtype=np.float32, backend="pallas")

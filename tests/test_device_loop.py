@@ -1,0 +1,190 @@
+"""Device-resident stage loop: unit semantics + host-loop equality.
+
+The equality tests run the FULL driver twice on the CPU backend — host
+per-iteration loop vs the lax.while_loop stage runner (XLA step) — and
+require identical consensus, scores, per-stage iteration counts, and
+per-iteration consensus history (engine.device_loop's bit-identity
+contract)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from rifraf_tpu.engine import device_loop as dl
+from rifraf_tpu.engine.driver import rifraf
+from rifraf_tpu.engine.params import RifrafParams
+from rifraf_tpu.engine.proposals import (
+    Deletion,
+    Insertion,
+    ScoredProposal,
+    Substitution,
+    apply_proposals,
+    choose_candidates,
+)
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.sim.sample import sample_sequences
+
+
+def _decode_host(idx):
+    """Reference decode of the flat candidate layout (generation order)."""
+    if idx < 4:
+        return Insertion(0, idx)
+    r = idx - 4
+    j, k = divmod(r, 9)
+    if k < 4:
+        return Substitution(j, k)
+    if k == 4:
+        return Deletion(j)
+    return Insertion(j + 1, k - 5)
+
+
+def test_decode_matches_generation_order():
+    """The flat layout must enumerate proposals exactly as
+    engine.generate.all_proposals emits them (ties in choose_candidates
+    break by this order)."""
+    from rifraf_tpu.engine.generate import all_proposals
+    from rifraf_tpu.engine.params import Stage
+
+    consensus = np.array([0, 1, 2, 3, 1], dtype=np.int8)
+    want = all_proposals(Stage.INIT, consensus, False)
+    got = []
+    for idx in range(4 + 9 * len(consensus)):
+        p = _decode_host(idx)
+        if isinstance(p, Substitution) and consensus[p.pos] == p.base:
+            continue  # masked own-base slot
+        got.append(p)
+    assert got == want
+
+    kind, pos, base, anchor = (np.asarray(v) for v in dl._decode(
+        jnp.arange(4 + 9 * len(consensus))
+    ))
+    from rifraf_tpu.engine.proposals import anchor as host_anchor
+
+    for idx in range(4 + 9 * len(consensus)):
+        p = _decode_host(idx)
+        want_kind = {Substitution: 0, Deletion: 1, Insertion: 2}[type(p)]
+        assert kind[idx] == want_kind, idx
+        assert pos[idx] == p.pos, idx
+        if not isinstance(p, Deletion):
+            assert base[idx] == p.base, idx
+        assert anchor[idx] == host_anchor(p), idx
+
+
+@pytest.mark.parametrize("seed", [0, 7, 19])
+def test_apply_matches_host_apply_proposals(seed):
+    """_apply == apply_proposals for random min-dist-separated sets."""
+    rng = np.random.default_rng(seed)
+    Tmax = 64
+    tlen = int(rng.integers(20, 50))
+    tmpl = rng.integers(0, 4, size=Tmax).astype(np.int8)
+    # build a random min-dist-separated proposal set via the real filter
+    cands = []
+    for idx in rng.permutation(4 + 9 * tlen - 9)[:40]:
+        cands.append(ScoredProposal(_decode_host(int(idx)),
+                                    float(rng.normal())))
+    chosen = choose_candidates(cands, 6)
+    want = apply_proposals(tmpl[:tlen], [c.proposal for c in chosen])
+
+    kind = np.zeros(dl.CAP, np.int32)
+    pos = np.zeros(dl.CAP, np.int32)
+    base = np.zeros(dl.CAP, np.int32)
+    keep = np.zeros(dl.CAP, bool)
+    for i, c in enumerate(chosen):
+        p = c.proposal
+        kind[i] = {Substitution: 0, Deletion: 1, Insertion: 2}[type(p)]
+        pos[i] = p.pos
+        base[i] = getattr(p, "base", 0)
+        keep[i] = True
+    out, new_tlen = dl._apply(
+        jnp.asarray(tmpl), jnp.int32(tlen), jnp.asarray(kind),
+        jnp.asarray(pos), jnp.asarray(base), jnp.asarray(keep), Tmax,
+    )
+    got = np.asarray(out)[: int(new_tlen)]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_choose_matches_host_choose_candidates():
+    """_choose (top-k + greedy min-dist walk) == choose_candidates on a
+    dense random score vector, including tie behavior."""
+    rng = np.random.default_rng(3)
+    tlen = 40
+    P = 4 + 9 * tlen
+    scores = np.full(P, float(dl.NEG), np.float32)
+    hot = rng.choice(P, size=60, replace=False)
+    scores[hot] = rng.choice([1.0, 2.0, 3.0], size=60).astype(np.float32)
+
+    min_dist = 6
+    kind, pos, base, keep, n_improving, best = (
+        np.asarray(v) for v in dl._choose(jnp.asarray(scores), min_dist)
+    )
+    got = []
+    order = np.asarray(jax.lax.top_k(jnp.asarray(scores), dl.CAP)[1])
+    for c in range(dl.CAP):
+        if keep[c]:
+            got.append(_decode_host(int(order[c])))
+
+    cands = [
+        ScoredProposal(_decode_host(int(i)), float(scores[i]))
+        for i in np.nonzero(scores > float(dl.NEG))[0]
+    ]
+    want = [c.proposal for c in choose_candidates(cands, min_dist)]
+    assert int(n_improving) == len(cands)
+    assert got == want
+
+
+_EQ_KW = dict(batch_size=0, batch_fixed=False, do_alignment_proposals=False)
+
+
+@pytest.mark.parametrize("seed,err,use_ref", [(5, 0.08, False), (13, 0.05, True)])
+def test_device_loop_matches_host_loop(seed, err, use_ref):
+    """Full-driver equality: device_loop='on' must reproduce the host
+    loop exactly — consensus, score, per-stage iteration counts, and the
+    complete per-iteration consensus history."""
+    REF_SCORES = Scores.from_error_model(ErrorModel(8.0, 0.1, 0.1, 1.0, 1.0))
+    rng = np.random.default_rng(seed)
+    ref, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=8, length=100, error_rate=err, rng=rng,
+        ref_error_rate=0.1, ref_errors=ErrorModel(8.0, 0.0, 0.0, 1.0, 1.0),
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    r = ref if use_ref else None
+    base = rifraf(seqs, phreds=phreds, reference=r,
+                  params=RifrafParams(device_loop="off", ref_scores=REF_SCORES,
+                                      **_EQ_KW))
+    dev = rifraf(seqs, phreds=phreds, reference=r,
+                 params=RifrafParams(device_loop="on", ref_scores=REF_SCORES,
+                                     **_EQ_KW))
+    assert np.array_equal(base.consensus, dev.consensus)
+    assert np.isclose(base.state.score, dev.state.score, rtol=1e-12)
+    assert base.state.stage_iterations.tolist() == \
+        dev.state.stage_iterations.tolist()
+    for a, b in zip(base.consensus_stages, dev.consensus_stages):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y)
+
+
+def test_device_loop_respects_max_iters():
+    """iters_left must bound the device stage exactly like max_iters
+    bounds the host loop."""
+    rng = np.random.default_rng(11)
+    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=6, length=80, error_rate=0.08, rng=rng,
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    for mi in (1, 2):
+        base = rifraf(seqs, phreds=phreds,
+                      params=RifrafParams(device_loop="off", max_iters=mi,
+                                          **_EQ_KW))
+        dev = rifraf(seqs, phreds=phreds,
+                     params=RifrafParams(device_loop="on", max_iters=mi,
+                                         **_EQ_KW))
+        assert np.array_equal(base.consensus, dev.consensus)
+        assert int(dev.state.stage_iterations.sum()) <= mi
+        assert base.state.stage_iterations.tolist() == \
+            dev.state.stage_iterations.tolist()
+        # a budget-truncated stage must NOT report convergence
+        # (finish_stage only fires when the stage ended itself)
+        assert base.state.converged == dev.state.converged

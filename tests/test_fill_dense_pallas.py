@@ -1,0 +1,147 @@
+"""Oracle tests for the second-generation Pallas engines.
+
+The kernels themselves run in interpret mode here (the suite forces the
+CPU backend) and are SLOW to trace, so the full fill+dense oracle is
+marked slow; the pure-XLA helpers (backward alignment, halo blocking)
+are tested cheaply against the flip oracle. On-TPU equivalence runs via
+exp/fill_pallas_check.py / exp/dense_pallas_check.py and the driver
+equality tests.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax, dense_pallas, fill_pallas
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+
+
+def _problem(tlen=24, n_reads=4, bw=5, seed=3):
+    rng = np.random.default_rng(seed)
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n_reads):
+        slen = int(rng.integers(max(4, tlen - 5), tlen + 6))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -1.0, size=slen)
+        reads.append(make_read_scores(s, log_p, bw, SCORES))
+    return template, batch_reads(reads, dtype=np.float32)
+
+
+def _setup(template, batch):
+    tlen = len(template)
+    geom = align_jax.batch_geometry(batch, tlen)
+    K = fill_pallas.uniform_band_height(
+        np.asarray(geom.offset), np.asarray(geom.nd)
+    )
+    Tmax = ((tlen + 63) // 64) * 64
+    T1p = Tmax + 64
+    tpl = np.zeros(Tmax, np.int8)
+    tpl[:tlen] = template
+    Npad = ((batch.n_reads + 127) // 128) * 128
+    bufs = fill_pallas.build_fill_buffers(
+        jnp.asarray(batch.seq), jnp.asarray(batch.match),
+        jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+        jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
+    )
+    lengths = np.asarray(batch.lengths)
+    r_unique = tuple(sorted({int(v) for v in lengths - lengths.min()}))
+    return tlen, geom, K, Tmax, T1p, tpl, Npad, bufs, r_unique
+
+
+def test_backward_halo_blocks_matches_flip_oracle():
+    """backward_halo_blocks (the memory-lean blocked flip+shift) must
+    reproduce flip_reversed_uniform's backward band on every in-band
+    cell, for every halo block."""
+    template, batch = _problem()
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs, r_unique = _setup(
+        template, batch
+    )
+    # reversed-problem forward band via the XLA oracle path: backward
+    # fill of align_jax gives B directly; reconstruct Brev from it by
+    # inverting the flip relation on a synthetic random band instead —
+    # simpler: make a random Brev and compare both mappings of it.
+    rng = np.random.default_rng(0)
+    Brev = rng.normal(size=(Npad, K, T1p)).astype(np.float32)
+    Brev_flat = jnp.asarray(
+        np.ascontiguousarray(Brev.transpose(2, 1, 0).reshape(T1p * K, Npad))
+    )
+    OFF = jnp.max(geom.offset).astype(jnp.int32)
+
+    # oracle mapping: B[k, d, j] = Brev[k, S_k - d, tlen - j]
+    B_oracle = fill_pallas.flip_reversed_uniform(
+        jnp.asarray(Brev), jnp.int32(tlen), bufs.lengths, OFF, K
+    )
+    B_oracle = np.asarray(B_oracle)
+
+    for C in (32, 64):
+        if T1p % C:
+            continue
+        Bh = np.asarray(dense_pallas.backward_halo_blocks(
+            Brev_flat, jnp.int32(tlen), OFF, bufs.lengths, r_unique,
+            K, T1p, C,
+        ))
+        n_steps = T1p // C
+        slen = np.asarray(bufs.lengths)
+        off = np.asarray(geom.offset)
+        for jb in range(n_steps):
+            blk = Bh[jb].reshape(C + 1, K, Npad)
+            for c in range(C + 1):
+                j = jb * C + c
+                if j > tlen:
+                    continue  # garbage by contract
+                for k in range(batch.n_reads):
+                    # compare in-band rows only (rolled-in rows are
+                    # garbage by contract)
+                    S = slen[k] - tlen + 2 * int(OFF)
+                    d_ok = np.arange(K)
+                    d_ok = d_ok[(S - d_ok >= 0) & (S - d_ok < K)]
+                    np.testing.assert_array_equal(
+                        blk[c, d_ok, k], B_oracle[k, d_ok, j],
+                        err_msg=f"C={C} jb={jb} c={c} read={k}",
+                    )
+
+
+@pytest.mark.slow
+def test_fused_step_pallas_matches_xla_dense_interpret():
+    """Full fill+backward+dense Pallas pipeline (interpret mode) ==
+    the XLA dense sweep oracle."""
+    from rifraf_tpu.ops.proposal_dense import score_all_edits
+
+    template, batch = _problem(tlen=20, n_reads=3, bw=4, seed=7)
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs, r_unique = _setup(
+        template, batch
+    )
+    # small C: interpret-mode tracing cost scales with the per-step
+    # column unroll; correctness is C-independent
+    C = 8
+    weights = np.ones(batch.n_reads, np.float32)
+    weights[1] = 0.0  # zero-weight masking
+    packed = np.asarray(dense_pallas.fused_step_pallas(
+        jnp.asarray(tpl), jnp.int32(tlen), bufs, geom,
+        jnp.asarray(weights), K, T1p, C, r_unique, interpret=True,
+    ))
+    lay = dense_pallas.pack_layout_pallas(Npad, T1p)
+    sub_t = packed[slice(*lay["sub"])].reshape(T1p, 4)
+    ins_t = packed[slice(*lay["ins"])].reshape(T1p, 4)
+    del_t = packed[slice(*lay["del"])]
+    sc = packed[slice(*lay["scores"])][: batch.n_reads]
+
+    Kx = align_jax.band_height(batch, tlen)
+    A, _, scores_x, _ = align_jax.forward_batch(tpl, batch, tlen=tlen, K=Kx)
+    B, _, _ = align_jax.backward_batch(tpl, batch, tlen=tlen, K=Kx)
+    sub_x, ins_x, del_x = (np.asarray(v) for v in score_all_edits(
+        A, B, batch, geom, jnp.asarray(weights)
+    ))
+    np.testing.assert_allclose(sc, np.asarray(scores_x), rtol=1e-5, atol=1e-5)
+    for got, want, hi in ((sub_t, sub_x, tlen), (ins_t, ins_x, tlen + 1),
+                          (del_t, del_x, tlen)):
+        g, w = got[:hi], want[:hi]
+        finite = np.isfinite(w)
+        np.testing.assert_allclose(g[finite], w[finite], rtol=2e-5, atol=2e-5)
+        assert (g[~finite] < -1e30).all()

@@ -37,9 +37,10 @@ import numpy as np
 # CPU-backend wall time of the IDENTICAL e2e headline run on the dev host
 # (python bench.py --cpu; see BASELINE.md). Backend verified "cpu" (the
 # env var alone silently keeps the TPU — see --cpu). The date/commit ride
-# along in the JSON so a stale baseline is detectable.
-CPU_E2E_SECONDS = 22.82
-CPU_BASELINE_META = {"date": "2026-07-30", "commit": "e61b598"}
+# along in the JSON so a stale baseline is detectable. Measured for the
+# round-4 config (do_alignment_proposals=False, see run_e2e).
+CPU_E2E_SECONDS = 20.29
+CPU_BASELINE_META = {"date": "2026-07-30", "commit": "f2c13c8"}
 # CPU-backend fused-step time for --step mode (round-2 measurement).
 CPU_BASELINE_STEP_SECONDS = 1.294
 
@@ -65,11 +66,20 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100):
     from rifraf_tpu.engine.driver import rifraf
     from rifraf_tpu.engine.params import RifrafParams
 
-    # no subsampling and no fixed top-k INIT batch: every iteration fills
-    # and rescores ALL reads (with defaults, a no-reference run stays in
-    # INIT on the top-batch_fixed_size reads only — that would benchmark
-    # 5-read fills regardless of n_reads)
-    kw = {"batch_size": 0, "batch_fixed": False}
+    # The TPU-native full-batch configuration, identical on BOTH
+    # backends so vs_baseline compares execution strategy, not
+    # algorithm:
+    # - no subsampling / no fixed top-k INIT batch: every iteration
+    #   fills and rescores ALL reads (with defaults, a no-reference run
+    #   stays in INIT on the top-batch_fixed_size reads only — that
+    #   would benchmark 5-read fills regardless of n_reads);
+    # - do_alignment_proposals=False: candidates come from the dense
+    #   all-edits tables (which both backends compute anyway) instead
+    #   of traceback-restricted sets — this is what makes the stage
+    #   loop device-resident (engine.device_loop, 'auto' engages it on
+    #   TPU; on CPU the same algorithm runs in the host loop).
+    kw = {"batch_size": 0, "batch_fixed": False,
+          "do_alignment_proposals": False}
     if bandwidth is not None:
         kw["bandwidth"] = bandwidth
     params = RifrafParams(max_iters=max_iters, **kw)

@@ -33,8 +33,7 @@ too far from its entry length for the compiled band margins.
 
 from __future__ import annotations
 
-import functools
-from typing import Callable, NamedTuple, Tuple
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +50,11 @@ class StageResult(NamedTuple):
     n_iters: int
     history: list  # per-iteration consensus snapshots (iteration tops)
     completed: bool  # stage ended itself (no candidates / score stall)
+    # the host loop's old_score to resume with: the score of the LAST
+    # COUNTED iteration's top (a bailed iteration was aborted, so the
+    # host must see the same old_score that iteration saw, not the
+    # current score — else its stall check compares the score to itself)
+    old_score: float = -np.inf
 
 
 def _candidate_scores(sub_t, ins_t, del_t, tmpl, tlen, total, do_indels,
@@ -266,6 +270,7 @@ def make_stage_runner(
             # a bailed iteration was ABORTED before applying anything:
             # the host must redo it, so it is not counted or recorded
             "n_rec": jnp.where(bail, it, it + 1),
+            "old_score_prev": carry["old_score"],
             "hist": hist,
             "hlen": hlen,
             "tlen0": carry["tlen0"],
@@ -294,6 +299,7 @@ def make_stage_runner(
             "iters_left": iters_left,
             "prev_iters": prev_iters,
             "step_state": step_state,
+            "old_score_prev": prev_score.astype(tables0[0].dtype),
         }
         out = jax.lax.while_loop(cond, body, carry)
         # ONE packed fetch: scalars, per-iteration lengths, history,
@@ -309,6 +315,12 @@ def make_stage_runner(
                 # with done's natural-termination causes absent, and the
                 # host loop must keep iterating, not finish_stage
                 (out["done"] & jnp.logical_not(out["bail"])).astype(pdt),
+                # resume old_score: a bailed iteration m was aborted, so
+                # the host redoing it must see what IT saw (the score of
+                # iteration m-1), not S_m — else check_score's stall
+                # test compares the score against itself
+                jnp.where(out["bail"], out["old_score_prev"],
+                          out["tables"][0]).astype(pdt),
             ]),
             out["hlen"].astype(pdt),
             out["hist"].astype(pdt).reshape(-1),
@@ -333,7 +345,8 @@ def make_stage_runner(
         total = float(packed[1])
         n_rec = int(packed[2])
         completed = bool(packed[3])
-        o = 4
+        resume_old = float(packed[4])
+        o = 5
         hlen = packed[o : o + H].astype(np.int64)
         o += H
         hist = packed[o : o + H * Tmax].reshape(H, Tmax).astype(np.int8)
@@ -346,6 +359,7 @@ def make_stage_runner(
             n_iters=n_rec,
             history=history,
             completed=completed,
+            old_score=resume_old,
         )
 
     return runner

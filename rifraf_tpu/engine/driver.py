@@ -480,9 +480,12 @@ def _try_device_stage(
         return None
     if params.verbose >= 2:
         return None
+    # full batch only: with a partial batch, check_score's batch-growth
+    # branch (driver.check_score:337-352) can fire on a score regression,
+    # which the device loop does not implement — restricting to the
+    # full-batch configs keeps the bit-identity contract airtight
     full_batch = state.batch_size >= len(state.sequences)
-    stable = full_batch or (state.stage == Stage.INIT and params.batch_fixed)
-    if not stable:
+    if not full_batch:
         return None
     if state.aligner is None or not bool(state.aligner.fixed.all()):
         return None
@@ -695,7 +698,10 @@ def rifraf(
                 res = None
         if res is not None:
             iterations_used += res.n_iters
-            old_score = res.score
+            # resume value: equals res.score for a completed stage; for a
+            # mid-stage bail it is what the aborted iteration saw, so the
+            # host's stall check doesn't compare the score with itself
+            old_score = res.old_score
             if state.converged:
                 break
             continue

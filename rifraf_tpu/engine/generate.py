@@ -128,9 +128,24 @@ def proposals_from_edits(
     return results
 
 
+def _align_moves_routed(consensus: np.ndarray, reference: ReadScores,
+                        skew_matches: bool = False):
+    """align_moves via the numpy engine for short pairs, the jitted codon
+    engine (ops.align_codon_jax, exact-equal by its oracle tests) for
+    long ones — the host column loop costs ~seconds per call at multi-kb
+    references."""
+    from ..ops.align_codon_jax import DEVICE_THRESHOLD, align_moves_device
+
+    if min(len(consensus), len(reference)) >= DEVICE_THRESHOLD:
+        return align_moves_device(consensus, reference,
+                                  skew_matches=skew_matches)
+    return align_np.align_moves(consensus, reference,
+                                skew_matches=skew_matches)
+
+
 def has_single_indels(consensus: np.ndarray, reference: ReadScores) -> bool:
     """model.jl:532-536."""
-    moves = align_np.align_moves(consensus, reference)
+    moves = _align_moves_routed(consensus, reference)
     return align_np.TRACE_INSERT in moves or align_np.TRACE_DELETE in moves
 
 
@@ -139,7 +154,7 @@ def single_indel_proposals(
 ) -> List[Proposal]:
     """Single (non-codon) indels from the consensus-vs-reference alignment,
     used as frame-correction seeds (model.jl:538-562)."""
-    moves = align_np.align_moves(consensus, reference, skew_matches=True)
+    moves = _align_moves_routed(consensus, reference, skew_matches=True)
     results: List[Proposal] = []
     cons_idx = 0
     ref_idx = 0

@@ -196,8 +196,13 @@ class BatchAligner:
         return out
 
     def _K(self, tlen: int) -> int:
-        batch = self.batch._replace(bandwidth=self.bandwidths)
-        return _bucket(align_jax.band_height(batch, tlen), 8)
+        # align_jax.band_height over HOST arrays: the batch's own
+        # lengths live on device, and np.asarray on them costs a full
+        # device->host round trip per call (profiled 0.33 s EACH at
+        # 2048 reads on the tunneled TPU)
+        bw = self.bandwidths.astype(np.int64)
+        nd = 2 * bw + np.abs(self._lengths_host.astype(np.int64) - tlen) + 1
+        return _bucket(int(nd.max()), 8)
 
     def _current_batch(self) -> ReadBatch:
         bw = self.bandwidths
@@ -346,11 +351,18 @@ class BatchAligner:
         if not bool(self.fixed.all()) or self.mesh is not None:
             return None
         Tmax = _bucket(tlen0 + 1, self.len_bucket)
-        key = (Tmax, do_indels, min_dist, history_cap, stop_on_same)
+        use_pallas = self.pallas_eligible(tlen0, False, False)
+        # K in the key: a re-entry after a drift bail re-centers the
+        # drift budget on the NEW entry length, so a cached runner whose
+        # compiled band height only covered the OLD entry length must
+        # not be reused (its band would silently truncate)
+        K = (self._pallas_K(tlen0, margin=MAX_DRIFT) if use_pallas
+             else _bucket(self._K(tlen0) + MAX_DRIFT, 8))
+        key = (Tmax, K, use_pallas, do_indels, min_dist, history_cap,
+               stop_on_same)
         if key in self._stage_runners:
             return self._stage_runners[key]
 
-        use_pallas = self.pallas_eligible(tlen0, False, False)
         n_reads = self.batch.n_reads
         T1 = Tmax + 1
         T1p = _bucket(T1, 64)
@@ -360,8 +372,6 @@ class BatchAligner:
         if use_pallas:
             from ..ops.dense_pallas import pick_dense_cols
 
-            # drift headroom: the template may shrink/grow inside the loop
-            K = self._pallas_K(tlen0, margin=MAX_DRIFT)
             C = pick_dense_cols(T1p, K)
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_stage_runner(
@@ -370,15 +380,7 @@ class BatchAligner:
             )
             state = (self._ensure_fill_bufs(), lengths_dev, bw_dev, weights)
         else:
-            from ..ops import align_jax
-
             batch = self._current_batch()
-            K = _bucket(
-                align_jax.band_height(
-                    batch._replace(bandwidth=self.bandwidths), tlen0
-                ) + MAX_DRIFT,
-                8,
-            )
             chunk = _pick_read_chunk(n_reads, K, T1, self.hbm_budget)
             weights = jnp.ones(n_reads, dtype=self.dtype)
             base = _xla_stage_runner(
@@ -448,29 +450,25 @@ class BatchAligner:
         if key == self._realign_key and bool(self.fixed.all()):
             return
         self._tlen = tlen
-        if bool(self.fixed.all()) and self.pallas_eligible(
-            tlen, want_moves, want_stats
-        ):
-            self._realign_pallas(t, tlen)
-            self._realign_key = key
-            return
         T1 = len(t) + 1
         weights = self._weights_dev
         if weights is None:
             weights = jnp.ones(self.batch.n_reads, dtype=self.dtype)
-        self._old_errors = np.full(len(self.reads), np.iinfo(np.int64).max)
-        # cap is computed ONCE from the bandwidths at entry
-        # (model.jl:650: seq.bandwidth * 2^5); recomputing from the
-        # already-doubled value each round would let a read grow past
-        # the final refill, leaving A and B with mismatched band heights
-        entry_bw = self.bandwidths.copy()
         t_dev = jnp.asarray(t, jnp.int8)
-        for _round in range(MAX_BANDWIDTH_DOUBLINGS + 1):
+        if not bool(self.fixed.all()):
+            # adaptation rounds: fills + traceback statistics ONLY — the
+            # dense all-edits sweep is the most expensive component of
+            # the step and its tables would be discarded every round the
+            # bandwidths grow (round-4 profile: adaptation dominated the
+            # whole run at 2048 reads)
+            self._adapt_bandwidths(t_dev, tlen, T1, weights, pvalue)
+        # final pass at settled bandwidths
+        if self.pallas_eligible(tlen, want_moves, want_stats):
+            self._realign_pallas(t, tlen)
+        else:
             batch = self._current_batch()
             K = self._K(tlen)
             geom = align_jax.batch_geometry(batch, tlen)
-            adapting = not bool(self.fixed.all())
-            stats_now = want_stats or adapting
             self.n_forward_fills += 1
             # sequential read chunks bound HBM for big problems; never
             # under a mesh (the read axis is already sharded across chips)
@@ -491,14 +489,14 @@ class BatchAligner:
                     weights,
                     K,
                     want_moves,
-                    stats_now,
+                    want_stats,
                     chunk,
                 )
             self.A_bands, self.B_bands = A, B
             self.moves, self.geom = moves, geom
             with self.timers.time("packed_fetch"):
                 ph = np.asarray(packed)
-            lay = pack_layout(self.batch.n_reads, T1, stats_now)
+            lay = pack_layout(self.batch.n_reads, T1, want_stats)
             self._total = float(ph[0])
             self.scores = ph[slice(*lay["scores"])]
             self._tables_host = (
@@ -506,8 +504,7 @@ class BatchAligner:
                 ph[slice(*lay["ins"])].reshape(T1, 4),
                 ph[slice(*lay["del"])],
             )
-            n_errors = None
-            if stats_now:
+            if want_stats:
                 n_errors = ph[slice(*lay["n_errors"])].astype(np.int64)
                 if (n_errors[: len(self.reads)] < 0).any():
                     raise RuntimeError(
@@ -525,16 +522,53 @@ class BatchAligner:
                     )
             else:
                 self.tracebacks = None
-            if not adapting:
-                break
-            grew = self._maybe_grow_bandwidth(n_errors, tlen, pvalue, entry_bw)
-            if not grew:
-                self.fixed[:] = True
-                break
         # store with the FINAL bandwidths (adaptation may have doubled
         # them above); the entry-time `key` would never hit again
         self._realign_key = (t.tobytes(), tlen, want_moves, want_stats,
                              self.bandwidths.tobytes())
+
+    def _adapt_bandwidths(self, t_dev, tlen: int, T1: int, weights,
+                          pvalue: float) -> None:
+        """Adaptive-bandwidth rounds (smart_forward_moves!,
+        model.jl:643-672): fill + device traceback statistics, fetch the
+        error counts, double band-limited reads, repeat until stable."""
+        from ..ops.fused import fused_step_full, pack_layout
+
+        self._old_errors = np.full(len(self.reads), np.iinfo(np.int64).max)
+        # cap is computed ONCE from the bandwidths at entry
+        # (model.jl:650: seq.bandwidth * 2^5); recomputing from the
+        # already-doubled value each round would let a read grow past
+        # the final refill, leaving A and B with mismatched band heights
+        entry_bw = self.bandwidths.copy()
+        for _round in range(MAX_BANDWIDTH_DOUBLINGS + 1):
+            batch = self._current_batch()
+            K = self._K(tlen)
+            geom = align_jax.batch_geometry(batch, tlen)
+            self.n_forward_fills += 1
+            chunk = (
+                0 if self.mesh is not None
+                else _pick_read_chunk(self.batch.n_reads, K, T1,
+                                      self.hbm_budget)
+            )
+            with self.timers.time("adapt_dispatch"):
+                _, _, _, packed = fused_step_full(
+                    t_dev, batch.seq, batch.match, batch.mismatch,
+                    batch.ins, batch.dels, geom, weights, K,
+                    False, True, chunk, False,
+                )
+            with self.timers.time("adapt_fetch"):
+                ph = np.asarray(packed)
+            lay = pack_layout(self.batch.n_reads, T1, True, False)
+            n_errors = ph[slice(*lay["n_errors"])].astype(np.int64)
+            if (n_errors[: len(self.reads)] < 0).any():
+                raise RuntimeError(
+                    "device traceback hit TRACE_NONE (malformed band)"
+                )
+            grew = self._maybe_grow_bandwidth(n_errors, tlen, pvalue,
+                                              entry_bw)
+            if not grew:
+                self.fixed[:] = True
+                break
 
     def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float,
                               entry_bw: np.ndarray) -> bool:
@@ -692,43 +726,100 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
 
 
 class RefAligner:
-    """Host-side consensus-vs-reference alignment state (A_ref/B_ref/
-    Amoves_ref, model.jl:180-182). Single sequence with codon moves — stays
-    on the numpy oracle engine."""
+    """Consensus-vs-reference alignment state (A_ref/B_ref/Amoves_ref,
+    model.jl:180-182). Single sequence with codon moves. Short pairs run
+    the numpy oracle engine; long ones the jitted codon engine
+    (ops.align_codon_jax — the host column loop measured ~11 s per
+    realign at a 9 kb reference), which is exact-equal by its oracle
+    tests."""
 
     def __init__(self):
         self.A: Optional[BandedArray] = None
         self.B: Optional[BandedArray] = None
         self.Amoves: Optional[BandedArray] = None
+        self._dev = None  # CodonDeviceAligner for long refs
+        self._dev_consensus = None
+
+    @staticmethod
+    def _use_device(consensus: np.ndarray, ref: ReadScores) -> bool:
+        from ..ops.align_codon_jax import DEVICE_THRESHOLD
+
+        return min(len(consensus), len(ref)) >= DEVICE_THRESHOLD
+
+    @staticmethod
+    def _adapt_loop(fill_fn, count_fn, consensus, ref: ReadScores,
+                    pvalue: float) -> None:
+        """The shared adaptive-bandwidth protocol (smart_forward_moves!,
+        model.jl:643-672), parameterized over the fill engine so the
+        host and device paths cannot drift."""
+        max_bw = min(ref.bandwidth << MAX_BANDWIDTH_DOUBLINGS,
+                     len(consensus), len(ref))
+        if ref.bandwidth_fixed:
+            max_bw = ref.bandwidth
+        n_errors = old_n_errors = np.iinfo(np.int64).max
+        while True:
+            fill_fn()
+            if ref.bandwidth_fixed or ref.bandwidth >= max_bw:
+                break
+            old_n_errors = n_errors
+            n_errors = count_fn()
+            threshold = poisson_cquantile(ref.est_n_errors, pvalue)
+            if n_errors > threshold and n_errors < old_n_errors:
+                ref.bandwidth = min(ref.bandwidth * 2, max_bw)
+            else:
+                break
+        ref.bandwidth_fixed = True
 
     def realign(self, consensus: np.ndarray, ref: ReadScores, pvalue: float,
                 realign_As: bool = True, realign_Bs: bool = True) -> None:
         """smart_forward_moves! + backward! for the reference."""
+        if self._use_device(consensus, ref):
+            self._realign_device(consensus, ref, pvalue, realign_Bs)
+            return
+        self._dev = None
         if realign_As:
-            max_bw = min(ref.bandwidth << MAX_BANDWIDTH_DOUBLINGS, len(consensus), len(ref))
-            if ref.bandwidth_fixed:
-                max_bw = ref.bandwidth
-            n_errors = old_n_errors = np.iinfo(np.int64).max
-            while True:
-                self.A, self.Amoves = align_np.forward_moves_vec(consensus, ref)
-                if ref.bandwidth_fixed or ref.bandwidth >= max_bw:
-                    break
-                old_n_errors = n_errors
-                n_errors = align_np.count_errors_in_moves(self.Amoves, consensus, ref.seq)
-                threshold = poisson_cquantile(ref.est_n_errors, pvalue)
-                if n_errors > threshold and n_errors < old_n_errors:
-                    ref.bandwidth = min(ref.bandwidth * 2, max_bw)
-                else:
-                    break
-            ref.bandwidth_fixed = True
+
+            def fill():
+                self.A, self.Amoves = align_np.forward_moves_vec(
+                    consensus, ref
+                )
+
+            self._adapt_loop(
+                fill,
+                lambda: align_np.count_errors_in_moves(
+                    self.Amoves, consensus, ref.seq
+                ),
+                consensus, ref, pvalue,
+            )
         if realign_Bs:
             self.B = align_np.backward_vec(consensus, ref)
 
+    def _realign_device(self, consensus: np.ndarray, ref: ReadScores,
+                        pvalue: float, realign_Bs: bool = True) -> None:
+        """The same adaptive-bandwidth protocol on the jitted engine
+        (fills cache per consensus/bandwidth, so redundant calls are
+        free)."""
+        from ..ops.align_codon_jax import get_engine
+
+        self._dev = get_engine(ref)
+        self._adapt_loop(
+            lambda: self._dev.fill(consensus, ref.bandwidth,
+                                   want_moves=True,
+                                   want_backward=realign_Bs),
+            lambda: self._dev.n_errors(consensus),
+            consensus, ref, pvalue,
+        )
+        self.A = self.B = self.Amoves = None
+
     def score(self) -> float:
+        if self._dev is not None:
+            return self._dev.score()
         return float(self.A[self.A.nrows - 1, self.A.ncols - 1])
 
     def score_proposals(self, proposals: Sequence[Proposal],
                         consensus: np.ndarray, ref: ReadScores) -> np.ndarray:
+        if self._dev is not None:
+            return self._dev.score_proposals(proposals)
         newcols = np.full((self.A.nrows, 4), -np.inf)
         out = np.empty(len(proposals))
         for k, p in enumerate(proposals):

@@ -17,9 +17,9 @@ The backward band is computed as the forward DP of the reversed problem
 2*OFF``. The column remap is read-independent (flip + uniform roll);
 the row remap splits into a uniform roll and per-lane residuals
 ``r_k = slen_k - min(slen)`` that are STATIC per batch — so
-``align_backward`` does the whole remap with whole-array flips/rolls
+``backward_halo_blocks`` does the whole remap with in-block flips/rolls
 plus one masked roll per DISTINCT residual (a handful at realistic
-read-length spreads), all fused by XLA inside the surrounding jit.
+read-length spreads), one halo block at a time.
 
 The dense kernel
 ----------------
@@ -46,7 +46,6 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -57,63 +56,14 @@ from .fill_pallas import (
     FillBuffers,
     _cumop,
     _pad_lanes,
-    fill_uniform,
 )
 
 ROWS = 16  # padded per-column output rows (9 used)
 
 
-def align_backward(Brev_flat, tlen, OFF, slen, r_unique, K: int, T1p: int):
-    """Map the raw reversed-problem band to backward-band layout, in the
-    fill kernel's flat [T1p * K, Npad] layout.
-
-    ``B[d, j] = Brev[S_k - d, tlen - j]``; ``r_unique`` is the static
-    tuple of distinct ``slen_k - slen_min`` residuals (host-known per
-    batch; padding lanes carry slen 0 and are excluded from the min —
-    their content is garbage, masked by consumers). Rolled-in cells are
-    NOT re-masked: every consumer joins them against an out-of-band A
-    cell (NEG_INF sentinel) or masks by row range.
-    """
-    Npad = Brev_flat.shape[1]
-    B3 = Brev_flat.reshape(T1p, K, Npad)
-    # columns: want column j to hold Brev column (tlen - j)
-    B3 = B3[::-1]  # column j now holds Brev column T1p - 1 - j
-    B3 = jnp.roll(B3, tlen + 1 - T1p, axis=0)
-    # rows: want row d to hold Brev row (S_k - d)
-    B3 = B3[:, ::-1]  # row d now holds Brev row K - 1 - d
-    slen_min = jnp.min(jnp.where(slen > 0, slen, jnp.int32(2**30)))
-    S_min = slen_min - tlen + 2 * OFF
-    B3 = jnp.roll(B3, S_min - (K - 1), axis=1)  # uniform part of S_k
-    if len(r_unique) > 1:
-        r_lane = (slen - slen_min)[None, None, :]
-        out = B3
-        for r in r_unique:
-            if r == 0:
-                continue
-            out = jnp.where(r_lane == r, jnp.roll(B3, r, axis=1), out)
-        B3 = out
-    return B3.reshape(T1p * K, Npad)
-
-
-def block_backward_halo(Bal_flat, K: int, T1p: int, C: int):
-    """[T1p*K, Npad] -> [n_steps, (C+1)*K, Npad]: block jb holds columns
-    [jb*C, jb*C + C] (one halo column; the last block's halo is padding).
-    BlockSpec tilings cannot overlap, so the halo is materialized."""
-    Npad = Bal_flat.shape[1]
-    pad = jnp.full((K, Npad), NEG_INF, Bal_flat.dtype)
-    Bp = jnp.concatenate([Bal_flat, pad], axis=0)
-    n_steps = T1p // C
-    return jnp.stack(
-        [
-            jax.lax.dynamic_slice_in_dim(Bp, jb * C * K, (C + 1) * K, axis=0)
-            for jb in range(n_steps)
-        ]
-    )
-
-
 def backward_halo_blocks(Brev_flat, tlen, OFF, slen, r_unique, K: int,
                          T1p: int, C: int, lane0: int = 0):
-    """align_backward + block_backward_halo in ONE memory-lean pass.
+    """Backward-band alignment + halo blocking in ONE memory-lean pass.
 
     Produces the halo-blocked backward band [n_steps, (C+1)*K, Npad]
     directly from the raw reversed-problem band, one output block at a
@@ -125,9 +75,8 @@ def backward_halo_blocks(Brev_flat, tlen, OFF, slen, r_unique, K: int,
     combined [.., 2*Npad] output); ``lane0`` selects where the reversed
     stream's lanes start. Output block jb holds B columns
     [jb*C, jb*C + C] with B[d, j] = Brev[S_k - d, tlen - j]; cells with
-    j > tlen or rolled-in rows are garbage by the same contract as
-    align_backward (consumers mask by row range / join against A's
-    NEG sentinel)."""
+    j > tlen or rolled-in rows are garbage by contract (consumers mask
+    by row range / join against A's NEG sentinel)."""
     Npad = slen.shape[0]
     n_steps = T1p // C
     B3 = Brev_flat.reshape(T1p, K, -1)

@@ -39,12 +39,16 @@ DENSE_BLOCK_THRESHOLD = 2048
 
 def _fused_parts(
     template, seq, match, mismatch, ins, dels, geom, weights, K,
-    want_moves, want_stats,
+    want_moves, want_stats, want_tables=True,
 ):
     """The per-read-block device work: fills, dense tables, stats.
 
     Returns (A, B, moves_or_None, components) where components is a dict
-    of read-reduced/per-read pieces combinable across read blocks."""
+    of read-reduced/per-read pieces combinable across read blocks.
+    ``want_tables=False`` skips the dense all-edits sweep — the
+    bandwidth-adaptation rounds only consume scores and traceback
+    statistics, and the dense sweep is the single most expensive
+    component of the step (round-4 profile)."""
     fwd_bwd = jax.vmap(
         align_jax._fwd_bwd_one,
         in_axes=(None, 0, 0, 0, 0, 0, 0, None, None),
@@ -56,7 +60,11 @@ def _fused_parts(
     A, B = jax.lax.optimization_barrier((A, B))
 
     T1 = template.shape[0] + 1
-    if T1 > DENSE_BLOCK_THRESHOLD:
+    if not want_tables:
+        sub_t = jnp.zeros((0, 4), A.dtype)
+        ins_t = jnp.zeros((0, 4), A.dtype)
+        del_t = jnp.zeros((0,), A.dtype)
+    elif T1 > DENSE_BLOCK_THRESHOLD:
         # long templates: all-columns-at-once tiles exceed HBM; compute
         # the (already read-reduced) tables in sequential column blocks
         sub_t, ins_t, del_t = dense_tables_blocked(
@@ -105,11 +113,13 @@ def _pack(comp, dtype, want_stats):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("K", "want_moves", "want_stats", "read_chunk")
+    jax.jit,
+    static_argnames=("K", "want_moves", "want_stats", "read_chunk",
+                     "want_tables"),
 )
 def fused_step_full(
     template, seq, match, mismatch, ins, dels, geom, weights, K,
-    want_moves=False, want_stats=False, read_chunk=0,
+    want_moves=False, want_stats=False, read_chunk=0, want_tables=True,
 ):
     """One driver iteration's full device work in one dispatch.
 
@@ -141,7 +151,7 @@ def fused_step_full(
     if not read_chunk or seq.shape[0] <= read_chunk:
         A, B, moves, comp = _fused_parts(
             template, seq, match, mismatch, ins, dels, geom, weights, K,
-            want_moves, want_stats,
+            want_moves, want_stats, want_tables,
         )
         return A, B, moves, _pack(comp, match.dtype, want_stats)
 
@@ -175,7 +185,7 @@ def fused_step_full(
         seq_c, match_c, mismatch_c, ins_c, dels_c, geom_c, w_c = x
         _, _, moves_c, comp = _fused_parts(
             template, seq_c, match_c, mismatch_c, ins_c, dels_c, geom_c,
-            w_c, K, want_moves, want_stats,
+            w_c, K, want_moves, want_stats, want_tables,
         )
         if moves_c is None:
             moves_c = jnp.zeros((0,), jnp.int8)
@@ -200,7 +210,8 @@ def fused_step_full(
     return None, None, moves, _pack(comp, match.dtype, want_stats)
 
 
-def pack_layout(n_reads: int, T1: int, want_stats: bool):
+def pack_layout(n_reads: int, T1: int, want_stats: bool,
+                want_tables: bool = True):
     """Slice map of fused_step_full's packed array: name -> (start, stop)."""
     out = {}
     o = 0
@@ -215,9 +226,10 @@ def pack_layout(n_reads: int, T1: int, want_stats: bool):
     if want_stats:
         take("n_errors", n_reads)
         take("edits", T1 * 9)
-    take("sub", T1 * 4)
-    take("ins", T1 * 4)
-    take("del", T1)
+    if want_tables:
+        take("sub", T1 * 4)
+        take("ins", T1 * 4)
+        take("del", T1)
     return out
 
 

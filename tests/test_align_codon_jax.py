@@ -1,0 +1,238 @@
+"""Oracle tests for the jitted codon-capable reference engine
+(ops.align_codon_jax) against the numpy host engine (align_np /
+scoring_np), which is itself pinned to the reference's cell loop."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from rifraf_tpu.engine.proposals import Deletion, Insertion, Substitution
+from rifraf_tpu.engine.realign import RefAligner
+from rifraf_tpu.engine.scoring_np import score_proposal
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.ops import align_codon_jax as acj
+from rifraf_tpu.ops import align_np
+
+REF_SCORES = Scores.from_error_model(ErrorModel(10.0, 1e-1, 1e-1, 1.0, 1.0))
+
+
+def _pair(rng, L):
+    tlen = int(rng.integers(max(10, L - 9), L + 10))
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    ref_len = int(rng.integers(max(9, L - 6), L + 7) // 3 * 3)
+    ref_seq = rng.integers(0, 4, size=ref_len).astype(np.int8)
+    bw = int(rng.integers(5, 12))
+    rs = make_read_scores(ref_seq, np.full(ref_len, np.log10(0.1)), bw,
+                          REF_SCORES)
+    return template, tlen, rs, ref_len, bw
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_codon_fill_matches_host(seed):
+    """Forward/backward bands, final score, and move consistency vs the
+    numpy engine (fp ties between predecessors may break differently, so
+    moves are checked by predecessor-achieves-value, not bitwise)."""
+    rng = np.random.default_rng(seed)
+    template, tlen, rs, ref_len, bw = _pair(rng, 60)
+    assert rs.do_codon_moves
+
+    A_h, mv_h = align_np.forward_moves_vec(template, rs)
+    B_h = align_np.backward_vec(template, rs)
+
+    rt = acj.make_ref_tables(rs)
+    K = acj.band_height_codon(ref_len, tlen, bw)
+    Tmax, T1p = tlen + 8, tlen + 9
+    tpl = np.zeros(Tmax, np.int8)
+    tpl[:tlen] = template
+    fwd = acj.forward_codon(jnp.asarray(tpl), tlen, rt, K, T1p,
+                            want_moves=True)
+    bwd = acj.backward_codon(jnp.asarray(tpl), tlen, rt, K, T1p)
+
+    bands = np.asarray(fwd.bands)
+    starts = np.asarray(fwd.starts)
+    mvs = np.asarray(fwd.moves)
+    bbands = np.asarray(bwd.bands)
+    bstarts = np.asarray(bwd.starts)
+    for j in range(tlen + 1):
+        lo, hi = A_h.row_range(j)
+        for i in range(lo, hi + 1):
+            want = A_h[i, j]
+            got = bands[j, i - starts[j]]
+            if np.isfinite(want):
+                assert np.isclose(got, want, rtol=1e-9, atol=1e-9), (i, j)
+            else:
+                assert not np.isfinite(got) or got < -1e30
+            bw_ = B_h[i, j]
+            bg = bbands[j, i - bstarts[j]]
+            if np.isfinite(bw_):
+                assert np.isclose(bg, bw_, rtol=1e-9, atol=1e-9), (i, j)
+            # move consistency
+            gm = mvs[j, i - starts[j]]
+            if np.isfinite(want) and not (i == 0 and j == 0):
+                if gm == align_np.TRACE_MATCH:
+                    e = (rs.match_scores[i - 1]
+                         if rs.seq[i - 1] == template[j - 1]
+                         else rs.mismatch_scores[i - 1])
+                    pred = A_h[i - 1, j - 1] + e
+                elif gm == align_np.TRACE_INSERT:
+                    pred = A_h[i - 1, j] + rs.ins_scores[i - 1]
+                elif gm == align_np.TRACE_DELETE:
+                    pred = A_h[i, j - 1] + rs.del_scores[i]
+                elif gm == align_np.TRACE_CODON_INSERT:
+                    pred = A_h[i - 3, j] + rs.codon_ins_scores[i - 3]
+                elif gm == align_np.TRACE_CODON_DELETE:
+                    pred = A_h[i, j - 3] + rs.codon_del_scores[i]
+                else:
+                    pred = np.nan
+                assert np.isclose(pred, want, rtol=1e-6, atol=1e-6), (i, j, gm)
+    assert np.isclose(float(np.asarray(fwd.score)), float(A_h[ref_len, tlen]),
+                      rtol=1e-9)
+
+
+def test_codon_proposal_scores_match_host():
+    """Every single-base edit scored by the vmapped device scorer equals
+    scoring_np.score_proposal (the model.jl:302-383 oracle), including
+    the just_a tail and suffix-deletion edge cases."""
+    rng = np.random.default_rng(9)
+    template, tlen, rs, ref_len, bw = _pair(rng, 50)
+    A_h, _ = align_np.forward_moves_vec(template, rs)
+    B_h = align_np.backward_vec(template, rs)
+
+    rt = acj.make_ref_tables(rs)
+    K = acj.band_height_codon(ref_len, tlen, bw)
+    Tmax, T1p = tlen + 8, tlen + 9
+    tpl = np.zeros(Tmax, np.int8)
+    tpl[:tlen] = template
+    fwd = acj.forward_codon(jnp.asarray(tpl), tlen, rt, K, T1p)
+    bwd = acj.backward_codon(jnp.asarray(tpl), tlen, rt, K, T1p)
+
+    props = []
+    for pos in range(tlen):
+        props.append(Deletion(pos))
+        props.append(Substitution(pos, int(rng.integers(0, 4))))
+        props.append(Insertion(pos, int(rng.integers(0, 4))))
+    props.append(Insertion(tlen, 2))
+    kinds = np.array([{Substitution: 0, Deletion: 1, Insertion: 2}[type(p)]
+                      for p in props], np.int32)
+    poss = np.array([p.pos for p in props], np.int32)
+    bases = np.array([getattr(p, "base", 0) for p in props], np.int32)
+    t_cols = np.zeros(T1p, np.int8)
+    t_cols[1 : tlen + 1] = template
+    got = np.asarray(acj._score_proposals_codon(
+        jnp.asarray(kinds), jnp.asarray(poss), jnp.asarray(bases),
+        jnp.asarray(t_cols), jnp.int32(tlen),
+        fwd.bands, fwd.starts, bwd.bands, bwd.starts,
+        tuple(rt[:9]), K, T1p, ref_len + 1, rt.do_cins, rt.do_cdel,
+    ))
+    want = np.array([score_proposal(p, A_h, B_h, template, rs)
+                     for p in props])
+    finite = np.isfinite(want)
+    np.testing.assert_allclose(got[finite], want[finite], rtol=1e-9,
+                               atol=1e-9)
+    assert (got[~finite] < -1e30).all()
+
+
+def test_ref_aligner_device_matches_host_engine():
+    """RefAligner's device routing (long refs) reproduces the host
+    engine: same score, same proposal scores, same adapted bandwidth."""
+    from rifraf_tpu.ops.align_codon_jax import DEVICE_THRESHOLD
+
+    L = DEVICE_THRESHOLD + 90
+    rng = np.random.default_rng(3)
+    ref_len = L // 3 * 3
+    ref_seq = rng.integers(0, 4, size=ref_len).astype(np.int8)
+    cons = ref_seq.copy().tolist()
+    for p in sorted(rng.choice(ref_len - 10, 3, replace=False))[::-1]:
+        cons.insert(int(p), int(rng.integers(0, 4)))
+    cons = np.array(cons, np.int8)
+
+    ref_d = make_read_scores(ref_seq, np.full(ref_len, np.log10(0.05)), 9,
+                             REF_SCORES)
+    ref_h = make_read_scores(ref_seq, np.full(ref_len, np.log10(0.05)), 9,
+                             REF_SCORES)
+
+    ra_d = RefAligner()
+    ra_d.realign(cons, ref_d, 0.1)
+    assert ra_d._dev is not None  # long pair took the device engine
+
+    # host engine, forced
+    ra_h = RefAligner()
+    max_bw = min(ref_h.bandwidth << 5, len(cons), len(ref_h))
+    n_errors = old = np.iinfo(np.int64).max
+    while True:
+        ra_h.A, ra_h.Amoves = align_np.forward_moves_vec(cons, ref_h)
+        if ref_h.bandwidth >= max_bw:
+            break
+        old, n_errors = n_errors, align_np.count_errors_in_moves(
+            ra_h.Amoves, cons, ref_h.seq)
+        from rifraf_tpu.utils.mathops import poisson_cquantile
+
+        if n_errors > poisson_cquantile(ref_h.est_n_errors, 0.1) and \
+                n_errors < old:
+            ref_h.bandwidth = min(ref_h.bandwidth * 2, max_bw)
+        else:
+            break
+    ra_h.B = align_np.backward_vec(cons, ref_h)
+
+    assert ref_d.bandwidth == ref_h.bandwidth
+    score_h = float(ra_h.A[ra_h.A.nrows - 1, ra_h.A.ncols - 1])
+    assert np.isclose(ra_d.score(), score_h, rtol=1e-9)
+
+    props = [Deletion(5), Substitution(40, 1), Insertion(100, 2),
+             Deletion(len(cons) - 1), Insertion(len(cons), 3)]
+    got = ra_d.score_proposals(props, cons, ref_d)
+    newcols = np.full((ra_h.A.nrows, 4), -np.inf)
+    want = np.array([
+        score_proposal(p, ra_h.A, ra_h.B, cons, ref_h, newcols)
+        for p in props
+    ])
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+def test_align_moves_routed_equivalence():
+    """generate's routed align_moves produces a path with the same
+    alignment score as the host engine's (tie-broken paths may differ;
+    their scores may not)."""
+    from rifraf_tpu.engine.generate import _align_moves_routed
+    from rifraf_tpu.ops.align_codon_jax import DEVICE_THRESHOLD
+
+    rng = np.random.default_rng(11)
+    ref_len = (DEVICE_THRESHOLD + 60) // 3 * 3
+    ref_seq = rng.integers(0, 4, size=ref_len).astype(np.int8)
+    cons = ref_seq.copy().tolist()
+    cons.insert(200, 2)
+    cons = np.array(cons, np.int8)
+    rs = make_read_scores(ref_seq, np.full(ref_len, np.log10(0.05)), 12,
+                          REF_SCORES)
+    moves_d = _align_moves_routed(cons, rs, skew_matches=True)
+    moves_h = align_np.align_moves(cons, rs, skew_matches=True)
+
+    def path_score(moves):
+        i = j = 0
+        total = 0.0
+        for m in moves:
+            if m == align_np.TRACE_MATCH:
+                i += 1
+                j += 1
+                total += (rs.match_scores[i - 1]
+                          if rs.seq[i - 1] == cons[j - 1]
+                          else rs.mismatch_scores[i - 1] * 0.99)
+            elif m == align_np.TRACE_INSERT:
+                i += 1
+                total += rs.ins_scores[i - 1]
+            elif m == align_np.TRACE_DELETE:
+                j += 1
+                total += rs.del_scores[i]
+            elif m == align_np.TRACE_CODON_INSERT:
+                i += 3
+                total += rs.codon_ins_scores[i - 3]
+            else:
+                j += 3
+                total += rs.codon_del_scores[i]
+        assert i == len(rs.seq) and j == len(cons)
+        return total
+
+    assert np.isclose(path_score(moves_d), path_score(moves_h), rtol=1e-9)

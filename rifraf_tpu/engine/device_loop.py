@@ -362,4 +362,8 @@ def make_stage_runner(
             old_score=resume_old,
         )
 
+    # the raw compiled whole-stage program: callers that batch a CLUSTER
+    # axis (parallel.sweep_sharded) vmap this directly and unpack the
+    # packed rows themselves
+    runner.run = run
     return runner

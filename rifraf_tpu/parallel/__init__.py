@@ -6,3 +6,4 @@ from .sharding import (
     shard_batch,
     sharded_consensus_step,
 )
+from .sweep_sharded import SweepResult, sweep_clusters_sharded

@@ -1,0 +1,252 @@
+"""Cluster-sharded consensus sweep: many clusters, one device program.
+
+The reference fans independent consensus jobs over Julia worker
+processes (scripts/rifraf.jl:190-191, `pmap`). parallel.cluster replaces
+that with device-pinned host threads — one PYTHON driver per cluster.
+This module is the third rung (BASELINE.json config 5, "1024-cluster
+sweep ... across a pod"): the WHOLE hill-climb of G clusters runs as one
+jitted program, vmapped over the cluster axis and sharded across a
+`jax.sharding.Mesh` — XLA partitions the program along clusters (no
+collectives needed; the axis is embarrassingly parallel), so a pod
+slice processes thousands of clusters with one dispatch per
+adaptation round plus one per stage sweep.
+
+Scope: the device-loop configuration (engine.device_loop) — no
+reference, full batch per cluster, all-edits candidates
+(do_alignment_proposals=False). Per-cluster results are BIT-IDENTICAL
+to running `rifraf()` per cluster in that configuration
+(tests/test_sweep_sharded.py): the same fused XLA step, the same
+candidate selection, the same adaptive-bandwidth protocol, just with a
+leading cluster axis everywhere (lax.while_loop under vmap keeps
+finished clusters frozen while stragglers iterate).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence
+
+import numpy as np
+
+from ..models.sequences import ReadScores, batch_reads
+from ..utils.mathops import logsumexp10, poisson_cquantile
+
+MAX_BANDWIDTH_DOUBLINGS = 5  # model.jl:650
+
+
+class SweepResult(NamedTuple):
+    consensus: np.ndarray
+    score: float
+    n_iters: int
+    converged: bool
+
+
+def _bucket(n: int, b: int) -> int:
+    return ((n + b - 1) // b) * b
+
+
+def sweep_clusters_sharded(
+    clusters: Sequence[Sequence[ReadScores]],
+    mesh=None,
+    max_iters: int = 100,
+    min_dist: int = 15,
+    bandwidth_pvalue: float = 0.1,
+    len_bucket: int = 64,
+    cluster_chunk: int = 0,
+) -> List[SweepResult]:
+    """One consensus per cluster, all clusters in one device program.
+
+    ``clusters``: per-cluster ReadScores lists (build with
+    make_read_scores). ``mesh``: optional Mesh whose FIRST axis shards
+    the cluster dimension; None runs unsharded on the default device.
+    ``cluster_chunk`` > 0 processes the cluster axis in sequential
+    chunks of that size (bands for every in-flight cluster live in HBM
+    simultaneously — a 1024-cluster batch can exceed one chip).
+    """
+    if cluster_chunk and len(clusters) > cluster_chunk:
+        out: List[SweepResult] = []
+        for s in range(0, len(clusters), cluster_chunk):
+            out.extend(sweep_clusters_sharded(
+                clusters[s : s + cluster_chunk], mesh=mesh,
+                max_iters=max_iters, min_dist=min_dist,
+                bandwidth_pvalue=bandwidth_pvalue, len_bucket=len_bucket,
+            ))
+        return out
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..engine.device_loop import make_stage_runner
+    from ..ops import align_jax
+    from ..ops.fused import fused_step_full, pack_layout
+
+    from ..engine.params import resolve_dtype
+
+    dtype = resolve_dtype(None)
+    G = len(clusters)
+    if G == 0:
+        return []
+    n_axis = mesh.devices.size if mesh is not None else 1
+    Gp = _bucket(G, max(n_axis, 1))
+    N = max(len(c) for c in clusters)
+    L = _bucket(max(len(r) for c in clusters for r in c), len_bucket)
+
+    # pad every cluster to [N] reads (repeating the first read at weight
+    # 0 keeps shapes without changing geometry bounds) and every read to
+    # [L]; clusters beyond G repeat cluster 0 at weight 0 everywhere
+    seqs = np.zeros((Gp, N, L), np.int8)
+    match = np.zeros((Gp, N, L), dtype)
+    mismatch = np.zeros((Gp, N, L), dtype)
+    ins = np.zeros((Gp, N, L), dtype)
+    dels = np.zeros((Gp, N, L + 1), dtype)
+    lengths = np.zeros((Gp, N), np.int32)
+    weights = np.zeros((Gp, N), dtype)
+    bandwidths = np.zeros((Gp, N), np.int32)
+    est_err = np.zeros((Gp, N), np.float64)
+
+    for g in range(Gp):
+        c = clusters[g] if g < G else clusters[0]
+        live = len(c) if g < G else 0
+        b = batch_reads(list(c) + [c[0]] * (N - len(c)), max_len=L,
+                        dtype=dtype)
+        seqs[g], match[g], mismatch[g] = b.seq, b.match, b.mismatch
+        ins[g], dels[g], lengths[g] = b.ins, b.dels, b.lengths
+        weights[g, :live] = 1.0
+        bandwidths[g] = [r.bandwidth for r in c] + [c[0].bandwidth] * (
+            N - len(c)
+        )
+        est_err[g] = [r.est_n_errors for r in c] + [c[0].est_n_errors] * (
+            N - len(c)
+        )
+
+    # initial consensus per cluster: the read with the best
+    # logsumexp10(match_scores) (model.jl:575-579)
+    tlens0 = np.zeros(Gp, np.int32)
+    Tmax = 0
+    best_idx = np.zeros(Gp, np.int64)
+    for g in range(Gp):
+        c = clusters[g] if g < G else clusters[0]
+        k = int(np.argmax([logsumexp10(r.match_scores) for r in c]))
+        best_idx[g] = k
+        tlens0[g] = len(c[k])
+        Tmax = max(Tmax, len(c[k]) + 1)
+    Tmax = _bucket(Tmax + 1, len_bucket)
+    tmpl0 = np.zeros((Gp, Tmax), np.int8)
+    for g in range(Gp):
+        c = clusters[g] if g < G else clusters[0]
+        r = c[int(best_idx[g])]
+        tmpl0[g, : len(r)] = r.seq
+
+    from ..engine.device_loop import MAX_DRIFT
+
+    T1 = Tmax + 1
+    shard = (
+        (lambda a, *spec: jax.device_put(
+            a, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], *spec))
+        ))
+        if mesh is not None
+        else (lambda a, *spec: jnp.asarray(a))
+    )
+
+    def shard_all(bw):
+        return (
+            shard(seqs, None, None), shard(match, None, None),
+            shard(mismatch, None, None), shard(ins, None, None),
+            shard(dels, None, None), shard(lengths, None),
+            shard(bw, None), shard(weights, None),
+        )
+
+    # ---- adaptive bandwidth (smart_forward_moves!, model.jl:643-672),
+    # all clusters per round in ONE vmapped dispatch ----
+    def adapt_round_fn(K):
+        def one(seq_g, match_g, mismatch_g, ins_g, dels_g, lengths_g,
+                bw_g, w_g, tmpl_g, tlen_g):
+            geom = align_jax.BandGeometry.make(lengths_g, tlen_g, bw_g)
+            _, _, _, packed = fused_step_full(
+                tmpl_g[: Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g,
+                geom, w_g, K, False, True, 0, False,
+            )
+            lay = pack_layout(N, T1, True, False)
+            return packed[slice(*lay["n_errors"])]
+
+        return jax.jit(jax.vmap(one))
+
+    entry_bw = bandwidths.copy()
+    fixed = np.zeros((Gp, N), bool)
+    fixed[weights == 0] = True
+    old_errors = np.full((Gp, N), np.iinfo(np.int64).max)
+    thresholds = np.array([
+        [poisson_cquantile(est_err[g, k], bandwidth_pvalue)
+         for k in range(N)] for g in range(Gp)
+    ])
+    for _ in range(MAX_BANDWIDTH_DOUBLINGS + 1):
+        K = int(
+            (2 * bandwidths + np.abs(lengths - tlens0[:, None]) + 1).max()
+        )
+        K = _bucket(K, 8)
+        n_err = np.asarray(adapt_round_fn(K)(
+            *shard_all(bandwidths), shard(tmpl0, None),
+            jnp.asarray(tlens0),
+        )).astype(np.int64)
+        max_bw = np.minimum(
+            np.minimum(entry_bw << MAX_BANDWIDTH_DOUBLINGS,
+                       tlens0[:, None]),
+            lengths,
+        )
+        grow = (~fixed) & (n_err > thresholds) & (n_err < old_errors) & (
+            bandwidths < max_bw
+        )
+        fixed |= ~grow
+        if not grow.any():
+            break
+        old_errors = np.where(grow, n_err, old_errors)
+        bandwidths = np.where(grow, np.minimum(bandwidths * 2, max_bw),
+                              bandwidths)
+
+    # ---- the whole INIT stage, vmapped over clusters ----
+    K = _bucket(
+        int((2 * bandwidths + np.abs(lengths - tlens0[:, None]) + 1).max())
+        + MAX_DRIFT,
+        8,
+    )
+    lay = pack_layout(N, T1, False)
+
+    def step_fn(tmpl, tlen, s):
+        (seq_g, match_g, mismatch_g, ins_g, dels_g), lengths_g, bw_g, w_g = s
+        geom = align_jax.BandGeometry.make(lengths_g, tlen, bw_g)
+        _, _, _, packed = fused_step_full(
+            tmpl[:Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g, geom,
+            w_g, K, False, False, 0,
+        )
+        sub_t = packed[slice(*lay["sub"])].reshape(T1, 4)
+        ins_t = packed[slice(*lay["ins"])].reshape(T1, 4)
+        del_t = packed[slice(*lay["del"])]
+        return packed[0], sub_t, ins_t, del_t
+
+    runner = make_stage_runner(
+        step_fn, do_indels=True, min_dist=min_dist, H=max_iters + 1,
+        Tmax=Tmax, stop_on_same=True,
+    )
+    sq_d, mt_d, mm_d, gi_d, dl_d, ln_d, bw_d, w_d = shard_all(bandwidths)
+    step_state = ((sq_d, mt_d, mm_d, gi_d, dl_d), ln_d, bw_d, w_d)
+
+    packed = jax.vmap(
+        lambda t0, tl, st: runner.run(t0, tl, -jnp.inf, jnp.int32(max_iters),
+                                      jnp.int32(0), st),
+        in_axes=(0, 0, ((0, 0, 0, 0, 0), 0, 0, 0)),
+    )(shard(tmpl0, None), jnp.asarray(tlens0), step_state)
+    packed = np.asarray(packed)
+
+    H = max_iters + 1
+    out = []
+    for g in range(G):
+        p = packed[g]
+        tlen = int(p[0])
+        total = float(p[1])
+        n_rec = int(p[2])
+        completed = bool(p[3])
+        o = 5 + H + H * Tmax
+        cons = p[o : o + Tmax].astype(np.int8)[:tlen]
+        out.append(SweepResult(
+            consensus=cons, score=total, n_iters=n_rec, converged=completed,
+        ))
+    return out

@@ -12,10 +12,20 @@ until convergence — NOT a microbenchmark of an unwired step.
 Timing protocol: one full warm-up run compiles every bucketed shape, then
 `N_TIMED` fresh runs are timed (identical seeded problem; the driver
 recomputes everything — only XLA executables are reused, exactly as in
-production). Reported value is the min.
+production). Reported value is the min; every individual run rides along
+in the JSON (`runs_s`) so environment variance (the TPU tunnel has shown
+~40% swings between rounds) is visible instead of silently folded in.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "runs_s": [...], "northstar_2048x1kb": {...}, "ref_default": {...}}
+
+The headline metric stays the 1 kb x 256 full-batch config; the same
+line also driver-captures (a) the BASELINE.json north-star config
+(2048 x 1 kb, the >=50x target) and (b) the REFERENCE-DEFAULT parameter
+set (fixed top-5 INIT batch, batch_size 20, alignment proposals — what
+cli/consensus.py actually runs), each with its own CPU-measured
+vs_baseline.
 
 `vs_baseline` is the speedup over this repo's CPU-backend wall time for
 the IDENTICAL end-to-end run on the dev host class (python bench.py --cpu
@@ -26,6 +36,7 @@ Other modes (results appended to BASELINE.md, not the driver JSON):
   --step       the round-2 fused-step microbenchmark (proposal-scores/s)
   --northstar  2048 x 1 kb and 10 kb x 512 x band-64 end-to-end configs
   --golden     the shipped-data CLI run (vs the reference's 3.6 s anchor)
+  --quick      headline only (skip the north-star / ref-default extras)
 """
 
 import json
@@ -34,19 +45,25 @@ import time
 
 import numpy as np
 
-# CPU-backend wall time of the IDENTICAL e2e headline run on the dev host
+# CPU-backend wall times of the IDENTICAL e2e runs on the dev host
 # (python bench.py --cpu; see BASELINE.md). Backend verified "cpu" (the
 # env var alone silently keeps the TPU — see --cpu). The date/commit ride
-# along in the JSON so a stale baseline is detectable. Measured for the
-# round-4 config (do_alignment_proposals=False, see run_e2e).
-CPU_E2E_SECONDS = 20.29
-CPU_BASELINE_META = {"date": "2026-07-30", "commit": "f2c13c8"}
+# along in the JSON so a stale baseline is detectable.
+CPU_E2E_SECONDS = 19.09  # headline: 1 kb x 256, full batch, all-edits
+CPU_NORTHSTAR_SECONDS = 369.0  # 2048 x 1 kb (round-3 measurement)
+# ref-default (fixed top-5 INIT batch, batch 20, alignment proposals):
+# the CPU *wins* this config (0.38 s vs ~1.0 s TPU) — per-iteration work
+# is a 5-20 read fill, far too small to amortize the ~100 ms/dispatch
+# tunnel latency; the full-batch headline config is the TPU-native
+# operating point. Reported honestly either way.
+CPU_REF_DEFAULT_SECONDS = 0.381
+CPU_BASELINE_META = {"date": "2026-07-30", "commit": "round-5"}
 # CPU-backend fused-step time for --step mode (round-2 measurement).
 CPU_BASELINE_STEP_SECONDS = 1.294
 
 TLEN = 1000
 N_READS = 256
-N_TIMED = 3
+N_TIMED = 5
 
 
 def build_e2e_problem(tlen=TLEN, n_reads=N_READS, seed=0, error_rate=0.01):
@@ -61,25 +78,33 @@ def build_e2e_problem(tlen=TLEN, n_reads=N_READS, seed=0, error_rate=0.01):
     return template, seqs, phreds
 
 
-def run_e2e(seqs, phreds, bandwidth=None, max_iters=100):
+def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False):
     """One full consensus; returns (wall_seconds, result)."""
     from rifraf_tpu.engine.driver import rifraf
     from rifraf_tpu.engine.params import RifrafParams
 
-    # The TPU-native full-batch configuration, identical on BOTH
-    # backends so vs_baseline compares execution strategy, not
-    # algorithm:
-    # - no subsampling / no fixed top-k INIT batch: every iteration
-    #   fills and rescores ALL reads (with defaults, a no-reference run
-    #   stays in INIT on the top-batch_fixed_size reads only — that
-    #   would benchmark 5-read fills regardless of n_reads);
-    # - do_alignment_proposals=False: candidates come from the dense
-    #   all-edits tables (which both backends compute anyway) instead
-    #   of traceback-restricted sets — this is what makes the stage
-    #   loop device-resident (engine.device_loop, 'auto' engages it on
-    #   TPU; on CPU the same algorithm runs in the host loop).
-    kw = {"batch_size": 0, "batch_fixed": False,
-          "do_alignment_proposals": False}
+    if ref_default:
+        # the REFERENCE-DEFAULT parameter set (model.jl:97-164 defaults:
+        # fixed top-5 INIT batch, batch_size 20 with growth, alignment-
+        # derived candidates) — the algorithm cli/consensus.py runs on
+        # real data; identical on both backends
+        kw = {}
+    else:
+        # The TPU-native full-batch configuration, identical on BOTH
+        # backends so vs_baseline compares execution strategy, not
+        # algorithm:
+        # - no subsampling / no fixed top-k INIT batch: every iteration
+        #   fills and rescores ALL reads (with defaults, a no-reference
+        #   run stays in INIT on the top-batch_fixed_size reads only —
+        #   that would benchmark 5-read fills regardless of n_reads);
+        # - do_alignment_proposals=False: candidates come from the dense
+        #   all-edits tables (which both backends compute anyway)
+        #   instead of traceback-restricted sets — this is what makes
+        #   the stage loop device-resident (engine.device_loop, 'auto'
+        #   engages it on TPU; on CPU the same algorithm runs in the
+        #   host loop).
+        kw = {"batch_size": 0, "batch_fixed": False,
+              "do_alignment_proposals": False}
     if bandwidth is not None:
         kw["bandwidth"] = bandwidth
     params = RifrafParams(max_iters=max_iters, **kw)
@@ -89,13 +114,13 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100):
 
 
 def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
-                max_iters=100, verbose=False):
+                max_iters=100, verbose=False, ref_default=False):
     template, seqs, phreds = build_e2e_problem(tlen, n_reads)
     walls = []
     result = None
     for i in range(n_timed + 1):  # first run compiles
         wall, result = run_e2e(seqs, phreds, bandwidth=bandwidth,
-                               max_iters=max_iters)
+                               max_iters=max_iters, ref_default=ref_default)
         if verbose:
             label = "compile+run" if i == 0 else "warm"
             print(f"  run {i}: {wall:.2f}s ({label})", file=sys.stderr)
@@ -103,7 +128,7 @@ def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
             walls.append(wall)
     n_iters = int(result.state.stage_iterations.sum())
     recovered = bool(np.array_equal(result.consensus, template))
-    return min(walls), n_iters, recovered, result
+    return walls, n_iters, recovered, result
 
 
 def _step_mode():
@@ -169,13 +194,15 @@ def _northstar_mode():
         ("2048x1kb", 1000, 2048, None, 2),
         ("10kbx512_band64", 10000, 512, 64, 1),
     ):
-        wall, n_iters, recovered, _ = measure_e2e(
+        walls, n_iters, recovered, _ = measure_e2e(
             tlen, n_reads, bandwidth=bandwidth, n_timed=n_timed, verbose=True
         )
+        wall = min(walls)
         print(json.dumps({
             "config": label,
             "backend": backend,
             "e2e_seconds": round(wall, 3),
+            "runs_s": [round(w, 3) for w in walls],
             "iterations": n_iters,
             "seconds_per_iteration": round(wall / max(n_iters, 1), 4),
             "template_recovered": recovered,
@@ -237,20 +264,75 @@ def main():
     if "--golden" in sys.argv:
         _golden_mode()
         return 0
+    if "--refdefault" in sys.argv:
+        # standalone ref-default measurement (use with --cpu to
+        # recalibrate CPU_REF_DEFAULT_SECONDS)
+        import jax
+
+        walls, it, rec, _ = measure_e2e(n_timed=2, verbose=True,
+                                        ref_default=True)
+        print(json.dumps({
+            "config": "ref_default_1kb_256",
+            "backend": jax.default_backend(),
+            "e2e_seconds": round(min(walls), 3),
+            "runs_s": [round(w, 3) for w in walls],
+            "iterations": it,
+            "template_recovered": rec,
+        }))
+        return 0
 
     import jax
 
-    wall, n_iters, recovered, _ = measure_e2e(verbose="--verbose" in sys.argv)
+    verbose = "--verbose" in sys.argv
+    if "--cpu" in sys.argv and "--quick" not in sys.argv:
+        # the CPU backend re-measures the headline only (the north-star
+        # config costs ~6 min per run there; its constant comes from
+        # BASELINE.md's recorded measurement)
+        sys.argv.append("--quick")
+    walls, n_iters, recovered, _ = measure_e2e(verbose=verbose)
+    wall = min(walls)
     out = {
         "metric": "rifraf_e2e_1kb_256reads_seconds",
         "value": round(wall, 3),
         "unit": "s",
         "vs_baseline": round(CPU_E2E_SECONDS / wall, 2),
+        "runs_s": [round(w, 3) for w in walls],
         "baseline_measured": CPU_BASELINE_META,
         "iterations": n_iters,
         "template_recovered": recovered,
         "backend": jax.default_backend(),
     }
+    if "--quick" not in sys.argv:
+        # driver-capture the north-star config (the >=50x target is
+        # DEFINED on 2048 x 1 kb — BASELINE.json) in the same JSON line
+        walls_ns, it_ns, rec_ns, _ = measure_e2e(
+            tlen=1000, n_reads=2048, n_timed=2, verbose=verbose
+        )
+        ns = min(walls_ns)
+        out["northstar_2048x1kb"] = {
+            "value": round(ns, 3),
+            "runs_s": [round(w, 3) for w in walls_ns],
+            "vs_baseline": round(CPU_NORTHSTAR_SECONDS / ns, 2),
+            "cpu_baseline_s": CPU_NORTHSTAR_SECONDS,
+            "iterations": it_ns,
+            "template_recovered": rec_ns,
+        }
+        # and the REFERENCE-DEFAULT parameter set (what cli/consensus.py
+        # runs): fixed top-5 INIT batch, batch growth, alignment proposals
+        walls_rd, it_rd, rec_rd, _ = measure_e2e(
+            n_timed=2, verbose=verbose, ref_default=True
+        )
+        rd = min(walls_rd)
+        out["ref_default_1kb_256"] = {
+            "value": round(rd, 3),
+            "runs_s": [round(w, 3) for w in walls_rd],
+            "iterations": it_rd,
+            "template_recovered": rec_rd,
+        }
+        if CPU_REF_DEFAULT_SECONDS:
+            out["ref_default_1kb_256"]["vs_baseline"] = round(
+                CPU_REF_DEFAULT_SECONDS / rd, 2
+            )
     print(json.dumps(out))
     return 0
 

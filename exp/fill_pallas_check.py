@@ -60,7 +60,7 @@ bufs = fill_pallas.build_fill_buffers(
 )
 
 t0 = time.perf_counter()
-A, Brev, sc, OFF = fill_pallas.fill_uniform(
+A, Brev, sc, OFF, _mv = fill_pallas.fill_uniform(
     jnp.asarray(tpl_pad), jnp.int32(tlen), bufs, geom, K, T1p,
     interpret=interpret,
 )
@@ -131,7 +131,7 @@ if "--time" in sys.argv:
     best = np.inf
     for i in range(6):
         t0 = time.perf_counter()
-        A2, Brev2, sc2, OFF2 = fill_pallas.fill_uniform(
+        A2, Brev2, sc2, OFF2, _mv2 = fill_pallas.fill_uniform(
             tpl_dev, jnp.int32(tlen), bufs, geom, K, T1p, interpret=interpret
         )
         B2 = fill_pallas.flip_reversed_uniform(

@@ -105,14 +105,14 @@ timeit("prep(build+block tables)", lambda: prep_only(tpl_dev, tl))
 
 # full fill_uniform without flip
 def fill_only():
-    A, Brev, sc, OFF = fill_pallas.fill_uniform(
+    A, Brev, sc, OFF, _ = fill_pallas.fill_uniform(
         tpl_dev, tl, bufs, geom, K, T1p)
     return A, Brev, sc
 
 timeit("fill_uniform (A,Brev,sc)", fill_only)
 
 def fill_flip():
-    A, Brev, sc, OFF = fill_pallas.fill_uniform(
+    A, Brev, sc, OFF, _ = fill_pallas.fill_uniform(
         tpl_dev, tl, bufs, geom, K, T1p)
     B = fill_pallas.flip_reversed_uniform(Brev, tl, bufs.lengths, OFF, K)
     return A, B, sc
@@ -123,7 +123,7 @@ timeit("fill_uniform + flip", fill_flip)
 # pallas outputs regardless; this just skips the reshape/transpose)
 @jax.jit
 def scores_only(template, tlen):
-    A, Brev, sc, OFF = fill_pallas.fill_uniform(
+    A, Brev, sc, OFF, _ = fill_pallas.fill_uniform(
         template, tlen, bufs, geom, K, T1p)
     return sc
 

@@ -1,0 +1,199 @@
+"""Roofline accounting for the Pallas fill/dense engines on the real TPU.
+
+Measures (dependent-chain, warm) the fused iteration step and its stats
+variants, counts the HBM bytes each program must move and the VPU work
+per cell, and prints achieved fractions of the chip's rooflines.
+
+Usage: python exp/roofline.py [TLEN] [N_READS] [BW]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax, dense_pallas, fill_pallas
+
+TLEN = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+N_READS = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+BW = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+
+# v5e public peaks (cloud.google.com/tpu/docs/v5e): 819 GB/s HBM BW,
+# 394 bf16 TFLOP/s MXU (unused here: the DP has no matmuls). The VPU
+# f32 roof is ~ (8 * 128 lanes * 4 ALUs * ~0.94 GHz) ~ 3.8 Top/s.
+HBM_GBPS = 819.0
+VPU_TOPS = 3.8
+
+scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+rng = np.random.default_rng(3)
+template = rng.integers(0, 4, size=TLEN).astype(np.int8)
+reads = []
+for n in range(N_READS):
+    slen = int(rng.integers(TLEN - 8, TLEN + 9))
+    s = rng.integers(0, 4, size=slen).astype(np.int8)
+    log_p = rng.uniform(-3.0, -1.0, size=slen)
+    reads.append(make_read_scores(s, log_p, BW, scores))
+batch = batch_reads(reads, dtype=np.float32)
+
+tlen = TLEN
+geom = align_jax.batch_geometry(batch, tlen)
+K = fill_pallas.uniform_band_height(np.asarray(geom.offset), np.asarray(geom.nd))
+Tmax = ((tlen + 63) // 64) * 64
+T1p = ((Tmax + 1 + 63) // 64) * 64
+tpl = np.zeros(Tmax, np.int8)
+tpl[:tlen] = template
+Npad = ((batch.n_reads + 127) // 128) * 128
+lengths = np.asarray(batch.lengths)
+
+bufs = fill_pallas.build_fill_buffers(
+    jnp.asarray(batch.seq), jnp.asarray(batch.match),
+    jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+    jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
+)
+jax.block_until_ready(bufs)
+C = dense_pallas.pick_dense_cols(T1p, K)
+n_steps = T1p // C
+CB = C + K
+print(f"K={K} T1p={T1p} C={C} Npad={Npad} backend={jax.default_backend()}")
+
+t_dev = jnp.asarray(tpl)
+w = jnp.ones(N_READS, jnp.float32)
+
+
+def chain_time(f, x0, n=5):
+    """Dependent-chain timing: each call's template derives from the
+    previous call's output so no async overlap hides latency."""
+    out = f(x0, 0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(n):
+        out = f(x0, jnp.int32(i) * 0 + (out[1] if isinstance(out, tuple) else out)[0].astype(jnp.int32) * 0)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def run_fused(t, _dep):
+    return dense_pallas.fused_step_pallas(
+        t_dev, jnp.int32(tlen), bufs, geom, w, K, T1p, C,
+    )
+
+
+def run_fused_stats(t, _dep):
+    return dense_pallas.fused_step_pallas(
+        t_dev, jnp.int32(tlen), bufs, geom, w, K, T1p, C,
+        want_stats=True,
+    )
+
+
+def run_fill_stats(t, _dep):
+    return dense_pallas.fill_stats_pallas(
+        t_dev, jnp.int32(tlen), bufs, geom, K,
+        T1p, fill_pallas._pick_cols(T1p, K, want_moves=True),
+    )
+
+
+def dep_chain(make, n=5):
+    out = make(t_dev, 0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    dep = 0
+    for i in range(n):
+        out = make(t_dev, dep)
+        first = out[0] if isinstance(out, tuple) else out
+        jax.block_until_ready(first)
+        dep = first
+    return (time.perf_counter() - t0) / n
+
+
+cells = 2 * K * T1p * Npad  # fwd + rev streams
+GB = 1e9
+
+# ---- HBM bytes per program (analytic) ----
+# fill kernel: 5 blocked tables per stream, halo'd (CB rows per C cols),
+# read once per grid step; band output written once; moves (stats
+# variants) written once as int32 then cast.
+tab_bytes = 2 * 5 * n_steps * CB * Npad * 4
+band_bytes = 2 * K * T1p * Npad * 4
+moves_bytes = K * T1p * (2 * Npad) * 4  # int32 out (fwd lanes used)
+# dense kernel: reads A (fwd half of band), halo-blocked B (written then
+# read), 5 fwd tables again; writes [T1p, 16, Npad] join maxima.
+bh_bytes = n_steps * (C + 1) * K * Npad * 4
+dense_read = K * T1p * Npad * 4 + bh_bytes + 5 * n_steps * CB * Npad * 4
+dense_out = T1p * 16 * Npad * 4
+fused_bytes = tab_bytes + band_bytes + bh_bytes * 2 + dense_read + dense_out
+
+t_fused = dep_chain(run_fused)
+t_stats = dep_chain(run_fused_stats)
+t_fill_stats = dep_chain(run_fill_stats)
+
+# VPU work per cell in the fill: ~2 table selects, 2 adds + max (cand),
+# 2 log-K scans (add + max) ~ 2*log2(K) ops, one select ~= 8 + 2*log2K
+ops_cell = 8 + 2 * np.log2(K)
+fill_ops = cells * ops_cell
+# dense: per column per base 2 scans + joins over K rows, 9 outputs
+dense_ops = T1p * Npad * K * (8 * (4 + 2 * np.log2(K)) + 10)
+
+for label, t, bts, ops in (
+    ("fused fill+align+dense", t_fused, fused_bytes, fill_ops + dense_ops),
+    ("  + stats (moves+scan)", t_stats, fused_bytes + moves_bytes, None),
+    ("adapt fill+stats (fwd only)", t_fill_stats,
+     tab_bytes / 2 + band_bytes / 2 + moves_bytes / 2, None),
+):
+    line = (f"{label}: {t*1e3:8.2f} ms | {bts/GB:6.2f} GB -> "
+            f"{bts/GB/t:6.1f} GB/s ({100*bts/GB/t/HBM_GBPS:5.1f}% of HBM roof)")
+    if ops:
+        line += (f" | {ops/1e9:6.1f} Gop -> {ops/1e12/t:5.2f} Top/s "
+                 f"({100*ops/1e12/t/VPU_TOPS:5.1f}% of VPU roof)")
+    print(line)
+
+print(f"cells (fwd+rev): {cells/1e6:.1f} M; cells/s (fused): "
+      f"{cells/t_fused/1e9:.2f} G")
+
+# ---- device-only time: N dependent iterations inside ONE jit ----
+# (the dependent-chain numbers above include the ~100 ms tunnel round
+# trip per block_until_ready; this isolates what the chip itself does)
+N_SCAN = 10
+
+
+@jax.jit
+def scan_fused(t0):
+    def body(tmpl, _):
+        out = dense_pallas.fused_tables_pallas(
+            tmpl, jnp.int32(tlen), bufs, geom, w, K, T1p, C,
+        )
+        # data dependency: xor the (always-zero) sign of the total in
+        dep = (out["total"] < -1e30).astype(jnp.int8)
+        return tmpl ^ dep, out["total"]
+
+    return jax.lax.scan(body, t0, None, length=N_SCAN)[1]
+
+
+@jax.jit
+def scan_stats(t0):
+    def body(tmpl, _):
+        out = dense_pallas.fused_tables_pallas(
+            tmpl, jnp.int32(tlen), bufs, geom, w, K, T1p, C,
+            want_stats=True,
+        )
+        dep = (out["total"] < -1e30).astype(jnp.int8)
+        return tmpl ^ dep, out["n_errors"].sum()
+
+    return jax.lax.scan(body, t0, None, length=N_SCAN)[1]
+
+
+for label, fn in (("fused", scan_fused), ("fused+stats", scan_stats)):
+    jax.block_until_ready(fn(t_dev))
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(t_dev))
+    dt = (time.perf_counter() - t0) / N_SCAN
+    bts = fused_bytes + (moves_bytes if "stats" in label else 0)
+    print(f"device-only {label}: {dt*1e3:7.2f} ms/iter | "
+          f"{bts/GB/dt:6.1f} GB/s ({100*bts/GB/dt/HBM_GBPS:5.1f}% HBM) | "
+          f"cells/s {cells/dt/1e9:.2f} G")

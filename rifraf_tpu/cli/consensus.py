@@ -74,6 +74,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--jobs", "-j", type=int, default=0,
                    help="concurrent consensus jobs; 0 = one per visible "
                         "device (the pmap fan-out of scripts/rifraf.jl)")
+    p.add_argument("--sharded-sweep", action="store_true",
+                   help="run ALL files' hill-climbs as one device program "
+                        "(parallel.sweep_clusters_sharded), vmapped over "
+                        "the cluster axis and sharded across every visible "
+                        "device; no-reference runs only")
+    p.add_argument("--cluster-chunk", type=int, default=0,
+                   help="with --sharded-sweep: process the cluster axis in "
+                        "sequential chunks of this size (bounds HBM); "
+                        "0 = all at once")
     p.add_argument("--verbose", "-v", type=int, default=0)
     p.add_argument("seq_errors", metavar="seq-errors",
                    help="comma-separated sequence error ratios - "
@@ -156,18 +165,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         basenames = [os.path.basename(f) for f in infiles]
         refids = [name_to_ref[n] for n in basenames]
 
-    from ..parallel.cluster import resolve_jobs_flag, sweep_clusters
+    if args.sharded_sweep:
+        if args.reference:
+            raise ValueError(
+                "--sharded-sweep supports no-reference runs only (FRAME "
+                "needs per-cluster reference state; use the default "
+                "thread sweep)"
+            )
+        outcomes = _run_sharded_sweep(infiles, basenames, args)
+    else:
+        from ..parallel.cluster import resolve_jobs_flag, sweep_clusters
 
-    n_workers = resolve_jobs_flag(args.jobs, len(infiles))
-    if args.verbose >= 1 and n_workers > 1:
-        print(f"sweeping {len(infiles)} files on {n_workers} workers",
-              file=sys.stderr)
-    results = sweep_clusters(
-        lambda job: dofile(job[0], args.reference, job[1], args,
-                           tag_logs=n_workers > 1),
-        list(zip(infiles, refids)),
-        max_workers=n_workers,
-    )
+        n_workers = resolve_jobs_flag(args.jobs, len(infiles))
+        if args.verbose >= 1 and n_workers > 1:
+            print(f"sweeping {len(infiles)} files on {n_workers} workers",
+                  file=sys.stderr)
+        results = sweep_clusters(
+            lambda job: dofile(job[0], args.reference, job[1], args,
+                               tag_logs=n_workers > 1),
+            list(zip(infiles, refids)),
+            max_workers=n_workers,
+        )
+        outcomes = [
+            (name, r.state.converged, r.consensus)
+            for name, r in zip(basenames, results)
+        ]
 
     plen = slen = 0
     if args.keep_unique_name:
@@ -177,17 +199,60 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     n_converged = 0
     out_seqs, out_names = [], []
-    for name, result in zip(basenames, results):
-        if result.state.converged:
+    for name, converged, consensus in outcomes:
+        if converged:
             n_converged += 1
             if args.keep_unique_name:
                 name = name[plen : len(name) - slen]
             out_names.append(args.prefix + name)
-            out_seqs.append(result.consensus)
+            out_seqs.append(consensus)
     write_fasta(args.output, out_seqs, names=out_names)
     if args.verbose >= 1:
-        print(f"done. {n_converged} / {len(results)} converged.", file=sys.stderr)
+        print(f"done. {n_converged} / {len(outcomes)} converged.",
+              file=sys.stderr)
     return 0
+
+
+def _run_sharded_sweep(infiles: List[str], basenames: List[str], args):
+    """Read every file's cluster and run all hill-climbs as ONE device
+    program (BASELINE.json config 5, user-reachable via --sharded-sweep).
+    Returns (name, converged, consensus) outcomes in input order."""
+    from ..models.sequences import make_read_scores
+    from ..parallel.sharding import make_mesh
+    from ..parallel.sweep_sharded import sweep_clusters_sharded
+    from ..utils.phred import phred_to_log_p
+
+    import jax
+
+    scores = parse_error_model(args.seq_errors)
+    params = RifrafParams(scores=scores, max_iters=args.max_iters)
+    clusters = []
+    for path in infiles:
+        sequences, phreds, _ = read_fastq(path)
+        if args.phred_cap > 0:
+            phreds = [cap_phreds(p, args.phred_cap) for p in phreds]
+        clusters.append([
+            make_read_scores(s, phred_to_log_p(p), params.bandwidth, scores)
+            for s, p in zip(sequences, phreds)
+        ])
+    n_dev = len(jax.devices())
+    mesh = make_mesh() if n_dev > 1 else None
+    if args.verbose >= 1:
+        print(
+            f"sharded sweep: {len(clusters)} clusters over {n_dev} "
+            "device(s), one program",
+            file=sys.stderr,
+        )
+    results = sweep_clusters_sharded(
+        clusters, mesh=mesh, max_iters=args.max_iters,
+        min_dist=params.min_dist,
+        bandwidth_pvalue=params.bandwidth_pvalue,
+        cluster_chunk=args.cluster_chunk,
+    )
+    return [
+        (name, r.converged, r.consensus)
+        for name, r in zip(basenames, results)
+    ]
 
 
 if __name__ == "__main__":

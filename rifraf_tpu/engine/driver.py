@@ -125,9 +125,22 @@ def _enable_compilation_cache() -> None:
     import jax
 
     try:
-        cache_dir = os.environ.get(
-            "RIFRAF_TPU_CACHE", os.path.expanduser("~/.cache/rifraf_tpu_xla")
-        )
+        # never override an already-configured cache dir (tests/conftest.py
+        # points each pytest process at its own private cache): redirecting
+        # it to the shared default made a test process and any concurrently
+        # running driver process write the SAME cache files, and the jax
+        # cache serializer segfaults under concurrent writers on this image
+        if jax.config.jax_compilation_cache_dir is not None:
+            return
+        cache_dir = os.environ.get("RIFRAF_TPU_CACHE")
+        if cache_dir is None:
+            from ..utils.cachedir import machine_cache_dir
+
+            cache_dir = machine_cache_dir(
+                os.path.expanduser("~/.cache/rifraf_tpu_xla")
+            )
+        elif not cache_dir or cache_dir == "off":
+            return
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
@@ -601,18 +614,34 @@ def base_distribution(base: int, ilp: float) -> np.ndarray:
 def alignment_error_probs(
     tlen: int, seqs: Sequence[ReadScores], tracebacks: Sequence[Sequence[int]]
 ) -> np.ndarray:
-    """Pileup-based per-base error probabilities (model.jl:811-840)."""
+    """Pileup-based per-base error probabilities (model.jl:811-840).
+
+    Vectorized over each read's whole move path (the reference walks
+    move-by-move in a scalar loop; at 2048 reads x 1 kb that is ~4M
+    Python iterations — here each read is three numpy scatters)."""
     probs = np.zeros((tlen, 4))
+    # per-move (di, dj) lookup tables (OFFSETS as arrays)
+    max_code = max(align_np.OFFSETS) + 1
+    DI = np.zeros(max_code, np.int64)
+    DJ = np.zeros(max_code, np.int64)
+    for code, (di, dj) in align_np.OFFSETS.items():
+        DI[code], DJ[code] = di, dj
+    log3 = np.log10(3.0)
     for s, moves in zip(seqs, tracebacks):
-        i = j = 0
-        for move in moves:
-            di, dj = align_np.OFFSETS[move]
-            i += di
-            j += dj
-            if move == align_np.TRACE_MATCH:
-                probs[j - 1] += base_distribution(
-                    int(s.seq[i - 1]), s.match_scores[i - 1]
-                )
+        m = np.asarray(moves, dtype=np.int64)
+        if m.size == 0:
+            continue
+        i = np.cumsum(DI[m])
+        j = np.cumsum(DJ[m])
+        sel = m == align_np.TRACE_MATCH
+        ii = i[sel] - 1
+        jj = j[sel] - 1
+        base = s.seq[ii].astype(np.int64)
+        ilp = s.match_scores[ii]
+        other = np.log10(1.0 - np.power(10.0, ilp)) - log3
+        # base_distribution: `other` in every column, `ilp` at the base
+        np.add.at(probs, jj, other[:, None])
+        np.add.at(probs, (jj, base), ilp - other)
     probs = np.power(10.0, probs)
     probs = 1.0 - (probs / probs.sum(axis=1, keepdims=True)).max(axis=1)
     return probs

@@ -94,12 +94,14 @@ class RifrafParams:
     # XLA-inserted psum over ICI for the score reductions (replaces the
     # reference's process-level pmap, scripts/rifraf.jl:190-191)
     mesh: Optional[object] = None
-    # alignment-fill engine. "auto": score-and-tables realigns run the
-    # on-core Pallas fill+dense kernels (ops.fill_pallas/dense_pallas)
-    # when eligible (TPU, f32, no mesh, sane read-length spread, fits
-    # HBM — BatchAligner.pallas_eligible), everything else the fused XLA
-    # scan step; "xla" forces the scan path everywhere. The retired
-    # first-generation kernel lives on only as ops.align_pallas.
+    # alignment-fill engine. "auto": realigns (score/tables, traceback
+    # statistics, SCORE-stage moves) run the on-core Pallas fill+dense
+    # kernels (ops.fill_pallas/dense_pallas; shard_map over the mesh's
+    # read axis when one is given) when eligible (TPU, f32, sane
+    # read-length spread, fits HBM — BatchAligner.pallas_eligible),
+    # everything else the fused XLA scan step; "xla" forces the scan
+    # path everywhere. The retired first-generation kernel lives on only
+    # as exp/align_pallas_gen1.py.
     backend: str = "auto"
     # whole-stage device-resident hill-climb (engine.device_loop): run
     # each eligible INIT/REFINE stage as ONE lax.while_loop dispatch —
@@ -129,19 +131,18 @@ def validate_backend(backend: str, dtype, mesh) -> None:
     if backend == "pallas":
         # an explicit request asserts the on-core path is available;
         # "auto" falls back silently instead
+        import os
+
         import jax
 
-        if mesh is not None:
-            raise ValueError(
-                "backend='pallas' does not support a mesh: the sharded "
-                "read axis runs on the XLA scan engines"
-            )
         if resolve_dtype(dtype) != np.float32:
             raise ValueError(
                 "backend='pallas' requires float32 (the on-core kernels "
                 "are f32; run with x64 disabled or dtype='float32')"
             )
-        if jax.default_backend() != "tpu":
+        if jax.default_backend() != "tpu" and not os.environ.get(
+            "RIFRAF_TPU_PALLAS_INTERPRET"
+        ):
             raise ValueError(
                 "backend='pallas' requires a TPU backend; on "
                 f"{jax.default_backend()!r} use 'auto' or 'xla'"

@@ -38,6 +38,16 @@ from .scoring_np import score_proposal as score_proposal_np
 
 MAX_BANDWIDTH_DOUBLINGS = 5  # model.jl:650: bandwidth * 2^5 cap
 
+
+def _pallas_interpret() -> bool:
+    """Test hook: RIFRAF_TPU_PALLAS_INTERPRET=1 makes the Pallas policy
+    accept non-TPU backends and runs every kernel in interpret mode, so
+    the whole Pallas realign path (incl. adaptation and stats) can be
+    driven end-to-end by the CPU suite."""
+    import os
+
+    return bool(os.environ.get("RIFRAF_TPU_PALLAS_INTERPRET"))
+
 _BYTES_PER_CELL = 22  # A+B f32, moves int8, ~2 transient copies
 
 
@@ -183,10 +193,6 @@ class BatchAligner:
         self._realign_key = None  # memo key of the last completed realign
         # Pallas-path state (built lazily; template-independent per batch)
         self._fill_bufs = None
-        self._r_unique = tuple(
-            sorted({int(v) for v in
-                    self._lengths_host - self._lengths_host.min()})
-        )
         self._stage_runners = {}
 
     def _padded_template(self, consensus: np.ndarray) -> np.ndarray:
@@ -231,23 +237,19 @@ class BatchAligner:
         K = int((off.max() - off + nd).max()) + margin
         return ((K + 7) // 8) * 8
 
-    def pallas_eligible(self, tlen: int, want_moves: bool,
-                        want_stats: bool) -> bool:
-        """Policy: the Pallas fill+dense path serves score-and-tables
-        realigns on a real TPU. Moves/stats (SCORE-stage tracebacks,
-        bandwidth adaptation, alignment proposals) stay on the XLA scan
-        engine, as do f64 exactness runs, sharded meshes (the read axis
-        lives across chips), pathological read-length spreads (the
-        uniform frame's K would blow up — see fill_pallas), and
-        working sets past the HBM budget (the XLA path read-chunks)."""
-        if self.backend == "xla" or want_moves or want_stats:
-            return False
-        if self.dtype != np.float32 or self.mesh is not None:
-            return False
+    def _pallas_mode(self, tlen: int):
+        """Which Pallas path serves this problem: "single" (one fused
+        launch), "panels" (the long-template carry-chained panel path,
+        ops.dense_pallas.fused_tables_pallas_panels), or None (XLA).
+        Raises when backend='pallas' was forced but nothing fits."""
+        if self.backend == "xla":
+            return None
+        if self.dtype != np.float32:
+            return None
         import jax
 
-        if jax.default_backend() != "tpu":
-            return False
+        if jax.default_backend() != "tpu" and not _pallas_interpret():
+            return None
         forced = self.backend == "pallas"
         K_uni = self._pallas_K(tlen)
         K_xla = self._K(tlen)
@@ -257,47 +259,163 @@ class BatchAligner:
                 f"uniform-frame band height {K_uni} blows up vs {K_xla} "
                 "(pathological read-length spread)"
             )
-        elif len(self._r_unique) > 24:
-            reason = (
-                f"{len(self._r_unique)} distinct read-length residuals "
-                "(backward alignment would compile too many rolls)"
-            )
         else:
-            Npad = _bucket(self.batch.n_reads, 128)
+            # per-device working set: under a mesh each shard holds only
+            # its local lanes (shard_map path)
+            if self.mesh is not None:
+                _, Npad, _ = self._mesh_npads()
+            else:
+                Npad = _bucket(self.batch.n_reads, 128)
             T1p = _bucket(_bucket(tlen + 1, self.len_bucket) + 1, 64)
-            if 4 * T1p * K_uni * Npad * 4 > self.hbm_budget:
-                reason = "band working set exceeds the HBM budget"
-        if reason is None:
-            return True
+            # single launch holds both streams' bands + the halo-blocked
+            # backward copy + dense temporaries (~4 bands); keep 1/3 of
+            # the budget as transient headroom — a barely-fitting single
+            # launch OOMs on XLA's scratch copies
+            if 4 * T1p * K_uni * Npad * 4 <= 0.66 * self.hbm_budget:
+                return "single"
+            # long templates: panel mode keeps ONE full band (donated
+            # in-place panel writes, no concat copy) + the int8 move
+            # band + O(panel) temporaries
+            band_bytes = T1p * K_uni * Npad * 4
+            if self.mesh is None and 2.0 * band_bytes <= self.hbm_budget:
+                return "panels"
+            reason = "band working set exceeds the HBM budget"
         if forced:
             raise RuntimeError(f"backend='pallas' unavailable: {reason}")
-        return False
+        return None
+
+    def pallas_eligible(self, tlen: int) -> bool:
+        """Policy: the Pallas fill+dense engines serve every realign
+        flavor on a real TPU — score-and-tables, traceback statistics
+        (bandwidth adaptation, alignment-derived proposals; the kernel
+        records moves and the stats scan consumes them in the uniform
+        frame), SCORE-stage move fetches, sharded meshes (shard_map),
+        and long templates (panel mode). The XLA scan engine keeps f64
+        exactness runs, pathological read-length spreads, and working
+        sets past even the panel path's budget."""
+        return self._pallas_mode(tlen) is not None
+
+    def _mesh_npads(self):
+        """(Nlocal, Npad_local, Npad_total) of the per-shard lane layout."""
+        n_dev = self.mesh.devices.size
+        Nlocal = self.batch.n_reads // n_dev
+        Npad_local = _bucket(Nlocal, 128)
+        return Nlocal, Npad_local, n_dev * Npad_local
+
+    def _mesh_read_slots(self, n: int) -> np.ndarray:
+        """Packed-array slot of each of the first n reads under the
+        per-shard lane padding (see mesh_fused_step_pallas)."""
+        Nlocal, Npad_local, _ = self._mesh_npads()
+        r = np.arange(n)
+        return (r // Nlocal) * Npad_local + (r % Nlocal)
 
     def _ensure_fill_bufs(self):
         if self._fill_bufs is None:
             import jax
 
-            from ..ops.fill_pallas import build_fill_buffers
-
             import jax.numpy as jnp
 
-            Npad = _bucket(self.batch.n_reads, 128)
-            self._fill_bufs = jax.block_until_ready(build_fill_buffers(
-                self.batch.seq, self.batch.match, self.batch.mismatch,
-                self.batch.ins, self.batch.dels,
-                jnp.asarray(self._lengths_host), Npad,
-            ))
+            if self.mesh is not None:
+                from ..parallel.sharding import mesh_fill_buffers
+
+                _, Npad_local, _ = self._mesh_npads()
+                self._fill_bufs = jax.block_until_ready(mesh_fill_buffers(
+                    self.mesh, self.batch, Npad_local
+                ))
+            else:
+                from ..ops.fill_pallas import build_fill_buffers
+
+                Npad = _bucket(self.batch.n_reads, 128)
+                self._fill_bufs = jax.block_until_ready(build_fill_buffers(
+                    self.batch.seq, self.batch.match, self.batch.mismatch,
+                    self.batch.ins, self.batch.dels,
+                    jnp.asarray(self._lengths_host), Npad,
+                ))
         return self._fill_bufs
 
-    def _realign_pallas(self, t: np.ndarray, tlen: int) -> None:
-        """The no-moves/no-stats realign on the Pallas engines: one
-        dispatch, one packed fetch (same contract as the XLA branch)."""
+    def _uniform_geom_host(self, tlen: int):
+        """Host-side uniform-frame geometry (fill_pallas.uniform_geometry
+        semantics) for the SCORE-stage traceback walk."""
+        from ..ops.align_jax import BandGeometry
+
+        lengths = self._lengths_host.astype(np.int64)
+        bw = self.bandwidths.astype(np.int64)
+        OFF = int((np.maximum(tlen - lengths, 0) + bw).max())
+        slen = lengths.astype(np.int32)
+        tl = np.full_like(slen, tlen)
+        return BandGeometry(
+            slen=slen,
+            tlen=tl,
+            bandwidth=(OFF - np.maximum(tl - slen, 0)).astype(np.int32),
+            offset=np.full_like(slen, OFF),
+            nd=np.full_like(slen, self._pallas_K(tlen)),
+        )
+
+    def _realign_pallas(self, t: np.ndarray, tlen: int,
+                        want_moves: bool = False,
+                        want_stats: bool = False) -> None:
+        """The realign on the Pallas engines: one dispatch, one packed
+        fetch (same contract as the XLA branch); want_stats adds the
+        in-kernel move recording + device traceback statistics, and
+        want_moves additionally ships the move band for the SCORE-stage
+        host traceback walk."""
+        import jax.numpy as jnp
+
+        from ..ops import align_jax
+        from ..ops.dense_pallas import fused_step_pallas, pick_dense_cols
+
+        T = len(t)
+        T1 = T + 1
+        T1p = _bucket(T1, 64)
+        K = self._pallas_K(tlen)
+        # interpret mode (CPU tests): a small column unroll keeps the
+        # traced kernel body — and its CPU compile time — bounded
+        C = 8 if _pallas_interpret() else pick_dense_cols(T1p, K)
+        bufs = self._ensure_fill_bufs()
+        batch = self._current_batch()
+        self.n_forward_fills += 1
+        if self.mesh is not None:
+            from ..parallel.sharding import mesh_fused_step_pallas
+
+            with self.timers.time("fused_dispatch"):
+                packed, moves_dev = mesh_fused_step_pallas(
+                    self.mesh, jnp.asarray(t, jnp.int8), jnp.int32(tlen),
+                    bufs, batch.lengths, batch.bandwidth,
+                    self._weights_dev.astype(jnp.float32),
+                    K, T1p, C,
+                    want_stats=want_stats, want_moves=want_moves,
+                    interpret=_pallas_interpret(),
+                )
+            _, _, Npad = self._mesh_npads()
+            slots = self._mesh_read_slots(self.batch.n_reads)
+        else:
+            geom = align_jax.batch_geometry(batch, tlen)
+            weights = jnp.ones(self.batch.n_reads, dtype=jnp.float32)
+            with self.timers.time("fused_dispatch"):
+                packed, moves_dev = fused_step_pallas(
+                    jnp.asarray(t, jnp.int8), jnp.int32(tlen), bufs, geom,
+                    weights, K, T1p, C,
+                    want_stats=want_stats, want_moves=want_moves,
+                    interpret=_pallas_interpret(),
+                )
+            Npad = bufs.seq_T.shape[1]
+            slots = np.arange(self.batch.n_reads)
+        self._finish_pallas_fetch(
+            packed, moves_dev, Npad, slots, T1p, T1, want_stats,
+            want_moves, tlen,
+        )
+
+    def _realign_pallas_panels(self, t: np.ndarray, tlen: int,
+                               want_moves: bool = False,
+                               want_stats: bool = False) -> None:
+        """Long-template realign on the panel-blocked Pallas path
+        (ops.dense_pallas.fused_tables_pallas_panels): same contract and
+        packed-single-fetch discipline as _realign_pallas."""
         import jax.numpy as jnp
 
         from ..ops import align_jax
         from ..ops.dense_pallas import (
-            fused_step_pallas,
-            pack_layout_pallas,
+            fused_tables_pallas_panels,
             pick_dense_cols,
         )
 
@@ -305,34 +423,90 @@ class BatchAligner:
         T1 = T + 1
         T1p = _bucket(T1, 64)
         K = self._pallas_K(tlen)
-        C = pick_dense_cols(T1p, K)
+        C = 8 if _pallas_interpret() else pick_dense_cols(T1p, K)
+        Npad = _bucket(self.batch.n_reads, 128)
+        # panel size: per-panel temporaries (~2.2 band-panels) stay a
+        # small fraction of the budget; multiple of C
+        per_col = 13 * K * Npad * 4
+        P = max(C, min(4096, int(self.hbm_budget // per_col)) // C * C)
         bufs = self._ensure_fill_bufs()
         batch = self._current_batch()
         geom = align_jax.batch_geometry(batch, tlen)
         weights = jnp.ones(self.batch.n_reads, dtype=jnp.float32)
         self.n_forward_fills += 1
         with self.timers.time("fused_dispatch"):
-            packed = fused_step_pallas(
+            out = fused_tables_pallas_panels(
                 jnp.asarray(t, jnp.int8), jnp.int32(tlen), bufs, geom,
-                weights, K, T1p, C, self._r_unique,
+                weights, K, T1p, C, panel_cols=P,
+                want_stats=want_stats, want_moves=want_moves,
+                interpret=_pallas_interpret(),
             )
+            from ..ops.dense_pallas import pack_parts
+
+            packed = jnp.concatenate(pack_parts(out, want_stats))
+        self._finish_pallas_fetch(
+            packed, out.get("moves"), Npad,
+            np.arange(self.batch.n_reads), T1p, T1, want_stats,
+            want_moves, tlen,
+        )
+
+    def _finish_pallas_fetch(self, packed, moves_dev, Npad, slots,
+                             T1p: int, T1: int, want_stats: bool,
+                             want_moves: bool, tlen: int) -> None:
+        """Shared tail of every Pallas realign flavor: ONE packed fetch,
+        unpack via pack_layout_pallas (the single consumer-side copy of
+        the section order), stats validation, and the optional move
+        fetch + host traceback walk."""
+        from ..ops.dense_pallas import pack_layout_pallas
+
         with self.timers.time("packed_fetch"):
             ph = np.asarray(packed)
-        Npad = bufs.seq_T.shape[1]
-        lay = pack_layout_pallas(Npad, T1p)
+        lay = pack_layout_pallas(Npad, T1p, want_stats, T1)
         self._total = float(ph[0])
-        self.scores = ph[slice(*lay["scores"])][: self.batch.n_reads]
+        self.scores = ph[slice(*lay["scores"])][slots]
         self._tables_host = (
             ph[slice(*lay["sub"])].reshape(T1p, 4)[:T1],
             ph[slice(*lay["ins"])].reshape(T1p, 4)[:T1],
             ph[slice(*lay["del"])][:T1],
         )
+        if want_stats:
+            n_errors = ph[slice(*lay["n_errors"])][slots].astype(np.int64)
+            if (n_errors[: len(self.reads)] < 0).any():
+                raise RuntimeError(
+                    "device traceback hit TRACE_NONE (malformed band)"
+                )
+            self.edits_seen = ph[slice(*lay["edits"])].reshape(T1, 9) > 0
+        else:
+            self.edits_seen = None
+        if want_moves:
+            with self.timers.time("moves_fetch"):
+                moves_host = np.asarray(moves_dev)[slots][:, :, :T1]
+            with self.timers.time("traceback_walk"):
+                self.tracebacks = align_jax.traceback_batch(
+                    moves_host, self._uniform_geom_host(tlen)
+                )
+        else:
+            self.tracebacks = None
         self.A_bands = None
         self.B_bands = None
         self.moves = None
-        self.geom = geom
-        self.tracebacks = None
-        self.edits_seen = None
+        self.geom = None
+
+    def _adapt_pallas_ok(self, tlen: int) -> bool:
+        """Adaptation rounds run the single-launch forward-only
+        fill+stats program whenever its (much smaller) working set fits
+        — even in panel mode, whose dense/backward streams are what
+        break the budget."""
+        mode = self._pallas_mode(tlen)
+        if mode == "single":
+            return True
+        if mode != "panels":
+            return False
+        K = self._pallas_K(tlen)
+        T1p = _bucket(_bucket(tlen + 1, self.len_bucket) + 1, 64)
+        Npad = _bucket(self.batch.n_reads, 128)
+        # fwd band f32 + moves int32 out + int8 copy + blocked tables
+        return 10 * T1p * K * Npad <= self.hbm_budget
 
     # --- device-resident stage loop ---------------------------------------
     def stage_runner(self, tlen0: int, do_indels: bool, min_dist: int,
@@ -351,7 +525,13 @@ class BatchAligner:
         if not bool(self.fixed.all()) or self.mesh is not None:
             return None
         Tmax = _bucket(tlen0 + 1, self.len_bucket)
-        use_pallas = self.pallas_eligible(tlen0, False, False)
+        mode = self._pallas_mode(tlen0)
+        if mode == "panels":
+            # the panel path is a host-driven launch sequence; compiling
+            # it unrolled inside the whole-stage while_loop would blow
+            # the program up -- the host loop drives panel realigns
+            return None
+        use_pallas = mode == "single"
         # K in the key: a re-entry after a drift bail re-centers the
         # drift budget on the NEW entry length, so a cached runner whose
         # compiled band height only covered the OLD entry length must
@@ -375,7 +555,7 @@ class BatchAligner:
             C = pick_dense_cols(T1p, K)
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_stage_runner(
-                K, T1p, C, self._r_unique, do_indels, min_dist,
+                K, T1p, C, do_indels, min_dist,
                 history_cap, Tmax, stop_on_same,
             )
             state = (self._ensure_fill_bufs(), lengths_dev, bw_dev, weights)
@@ -463,8 +643,11 @@ class BatchAligner:
             # whole run at 2048 reads)
             self._adapt_bandwidths(t_dev, tlen, T1, weights, pvalue)
         # final pass at settled bandwidths
-        if self.pallas_eligible(tlen, want_moves, want_stats):
-            self._realign_pallas(t, tlen)
+        mode = self._pallas_mode(tlen)
+        if mode == "panels":
+            self._realign_pallas_panels(t, tlen, want_moves, want_stats)
+        elif mode == "single":
+            self._realign_pallas(t, tlen, want_moves, want_stats)
         else:
             batch = self._current_batch()
             K = self._K(tlen)
@@ -541,25 +724,28 @@ class BatchAligner:
         # the final refill, leaving A and B with mismatched band heights
         entry_bw = self.bandwidths.copy()
         for _round in range(MAX_BANDWIDTH_DOUBLINGS + 1):
-            batch = self._current_batch()
-            K = self._K(tlen)
-            geom = align_jax.batch_geometry(batch, tlen)
-            self.n_forward_fills += 1
-            chunk = (
-                0 if self.mesh is not None
-                else _pick_read_chunk(self.batch.n_reads, K, T1,
-                                      self.hbm_budget)
-            )
-            with self.timers.time("adapt_dispatch"):
-                _, _, _, packed = fused_step_full(
-                    t_dev, batch.seq, batch.match, batch.mismatch,
-                    batch.ins, batch.dels, geom, weights, K,
-                    False, True, chunk, False,
+            if self._adapt_pallas_ok(tlen):
+                n_errors = self._adapt_round_pallas(t_dev, tlen)
+            else:
+                batch = self._current_batch()
+                K = self._K(tlen)
+                geom = align_jax.batch_geometry(batch, tlen)
+                self.n_forward_fills += 1
+                chunk = (
+                    0 if self.mesh is not None
+                    else _pick_read_chunk(self.batch.n_reads, K, T1,
+                                          self.hbm_budget)
                 )
-            with self.timers.time("adapt_fetch"):
-                ph = np.asarray(packed)
-            lay = pack_layout(self.batch.n_reads, T1, True, False)
-            n_errors = ph[slice(*lay["n_errors"])].astype(np.int64)
+                with self.timers.time("adapt_dispatch"):
+                    _, _, _, packed = fused_step_full(
+                        t_dev, batch.seq, batch.match, batch.mismatch,
+                        batch.ins, batch.dels, geom, weights, K,
+                        False, True, chunk, False,
+                    )
+                with self.timers.time("adapt_fetch"):
+                    ph = np.asarray(packed)
+                lay = pack_layout(self.batch.n_reads, T1, True, False)
+                n_errors = ph[slice(*lay["n_errors"])].astype(np.int64)
             if (n_errors[: len(self.reads)] < 0).any():
                 raise RuntimeError(
                     "device traceback hit TRACE_NONE (malformed band)"
@@ -569,6 +755,46 @@ class BatchAligner:
             if not grew:
                 self.fixed[:] = True
                 break
+
+    def _adapt_round_pallas(self, t_dev, tlen: int) -> np.ndarray:
+        """One adaptation round on the Pallas engine: forward-only fill
+        with in-kernel move recording + device traceback statistics —
+        no backward stream, no dense sweep (ops.dense_pallas.
+        fill_stats_pallas). Returns per-read alignment error counts."""
+        import jax.numpy as jnp
+
+        from ..ops.dense_pallas import fill_stats_pallas
+        from ..ops.fill_pallas import _pick_cols
+
+        T1p = _bucket(int(t_dev.shape[0]) + 1, 64)
+        K = self._pallas_K(tlen)
+        C = 8 if _pallas_interpret() else _pick_cols(T1p, K, want_moves=True)
+        bufs = self._ensure_fill_bufs()
+        batch = self._current_batch()
+        self.n_forward_fills += 1
+        if self.mesh is not None:
+            from ..parallel.sharding import mesh_fill_stats_pallas
+
+            with self.timers.time("adapt_dispatch"):
+                packed = mesh_fill_stats_pallas(
+                    self.mesh, t_dev, jnp.int32(tlen), bufs,
+                    batch.lengths, batch.bandwidth, K, T1p, C,
+                    interpret=_pallas_interpret(),
+                )
+            _, _, Npad = self._mesh_npads()
+            slots = self._mesh_read_slots(len(self.reads))
+        else:
+            geom = align_jax.batch_geometry(batch, tlen)
+            with self.timers.time("adapt_dispatch"):
+                packed = fill_stats_pallas(
+                    t_dev, jnp.int32(tlen), bufs, geom, K, T1p, C,
+                    interpret=_pallas_interpret(),
+                )
+            Npad = bufs.seq_T.shape[1]
+            slots = np.arange(self.batch.n_reads)
+        with self.timers.time("adapt_fetch"):
+            ph = np.asarray(packed)
+        return ph[Npad:][slots].astype(np.int64)
 
     def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float,
                               entry_bw: np.ndarray) -> bool:
@@ -674,7 +900,7 @@ class BatchAligner:
 
 
 @functools.lru_cache(maxsize=64)
-def _pallas_stage_runner(K, T1p, C, r_unique, do_indels, min_dist,
+def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
                          history_cap, Tmax, stop_on_same):
     """Compiled device stage loop over the Pallas fill+dense step, shared
     across aligners of identical shape config. step_state =
@@ -686,10 +912,11 @@ def _pallas_stage_runner(K, T1p, C, r_unique, do_indels, min_dist,
     def step_fn(tmpl, tlen, s):
         bufs, lengths, bw, weights = s
         geom = BandGeometry.make(lengths, tlen, bw)
-        total, _scores, sub_t, ins_t, del_t = fused_tables_pallas(
-            tmpl, tlen, bufs, geom, weights, K, T1p, C, r_unique
+        out = fused_tables_pallas(
+            tmpl, tlen, bufs, geom, weights, K, T1p, C,
+            interpret=_pallas_interpret(),
         )
-        return total, sub_t, ins_t, del_t
+        return out["total"], out["sub"], out["ins"], out["del"]
 
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same

@@ -46,6 +46,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -61,8 +62,9 @@ from .fill_pallas import (
 ROWS = 16  # padded per-column output rows (9 used)
 
 
-def backward_halo_blocks(Brev_flat, tlen, OFF, slen, r_unique, K: int,
-                         T1p: int, C: int, lane0: int = 0):
+def backward_halo_blocks(Brev_flat, tlen, OFF, slen, K: int,
+                         T1p: int, C: int, lane0: int = 0,
+                         slen_min=None, jb0=0, n_blocks=None):
     """Backward-band alignment + halo blocking in ONE memory-lean pass.
 
     Produces the halo-blocked backward band [n_steps, (C+1)*K, Npad]
@@ -76,16 +78,36 @@ def backward_halo_blocks(Brev_flat, tlen, OFF, slen, r_unique, K: int,
     stream's lanes start. Output block jb holds B columns
     [jb*C, jb*C + C] with B[d, j] = Brev[S_k - d, tlen - j]; cells with
     j > tlen or rolled-in rows are garbage by contract (consumers mask
-    by row range / join against A's NEG sentinel)."""
+    by row range / join against A's NEG sentinel). ``slen_min``
+    overrides the local minimum read length (any base works — the
+    binary-decomposed per-lane rolls are self-consistent with whichever
+    S_min base is used). ``jb0``/``n_blocks``
+    restrict the output to block rows [jb0, jb0 + n_blocks) — the
+    panel-mode fill processes one template panel at a time."""
     Npad = slen.shape[0]
     n_steps = T1p // C
     B3 = Brev_flat.reshape(T1p, K, -1)
     tlen = jnp.asarray(tlen, jnp.int32)
-    slen_min = jnp.min(jnp.where(slen > 0, slen, jnp.int32(2**30)))
+    if slen_min is None:
+        slen_min = jnp.min(jnp.where(slen > 0, slen, jnp.int32(2**30)))
+    else:
+        slen_min = jnp.asarray(slen_min, jnp.int32)
     S_min = slen_min - tlen + 2 * OFF
     r_lane = (slen - slen_min)[None, None, :]
 
-    def one_block(jb):
+    jb0 = jnp.asarray(jb0, jnp.int32)
+    if n_blocks is None:
+        n_blocks = n_steps
+    # per-lane residual roll via binary decomposition: log2(K)
+    # conditional power-of-two rolls compose to a roll by r_lane for
+    # ARBITRARY per-lane residuals (the old per-distinct-residual chain
+    # capped how many read lengths a batch could have). Residuals are
+    # < K whenever the uniform frame is sane (engine policy checks the
+    # length spread), so K bits always suffice.
+    n_bits = max(1, int(np.ceil(np.log2(max(K, 2)))))
+
+    def one_block(jb_local):
+        jb = jb0 + jb_local
         # B columns [jb*C, jb*C + C] = Brev columns [tlen-jb*C-C, tlen-jb*C]
         start_raw = tlen - jb * C - C
         start = jnp.maximum(start_raw, 0)
@@ -100,21 +122,20 @@ def backward_halo_blocks(Brev_flat, tlen, OFF, slen, r_unique, K: int,
         # rows: want row d = Brev row S_k - d
         blk = blk[:, ::-1]  # row d holds Brev row K-1-d
         blk = jnp.roll(blk, S_min - (K - 1), axis=1)
-        if len(r_unique) > 1:
-            out = blk
-            for r in r_unique:
-                if r == 0:
-                    continue
-                out = jnp.where(r_lane == r, jnp.roll(blk, r, axis=1), out)
-            blk = out
+        for b in range(n_bits):
+            step = 1 << b
+            blk = jnp.where(
+                (r_lane >> b) & 1 == 1, jnp.roll(blk, step, axis=1), blk
+            )
         return blk.reshape((C + 1) * K, Npad)
 
-    return jax.lax.map(one_block, jnp.arange(n_steps, dtype=jnp.int32))
+    return jax.lax.map(one_block, jnp.arange(n_blocks, dtype=jnp.int32))
 
 
 def _dense_kernel(
     tlen_ref,  # SMEM [1, 1]
     off_ref,  # SMEM [1, 1] uniform OFF
+    col0_ref,  # SMEM [1, 1] global column of this launch's first column
     slen_ref,  # [1, 1, 128] int32
     roff_ref,  # [1, 1, 128] int32 per-read band offset (geom.offset)
     bw_ref,  # [1, 1, 128] int32 per-read bandwidth
@@ -132,6 +153,7 @@ def _dense_kernel(
 ):
     tlen = tlen_ref[0, 0]
     OFF = off_ref[0, 0]
+    col0 = col0_ref[0, 0]
     jb = pl.program_id(1)
 
     slen = slen_ref[0, 0, :]
@@ -143,7 +165,7 @@ def _dense_kernel(
     v_off = jnp.maximum(slen - tlen, 0)
 
     for c in range(C):
-        j = jb * C + c
+        j = col0 + jb * C + c
         A_j = a_ref[0, c * K : (c + 1) * K, :]
         B_j = bh_ref[0, c * K : (c + 1) * K, :]
         B_n = bh_ref[0, (c + 1) * K : (c + 2) * K, :]
@@ -216,7 +238,10 @@ def dense_call(
     T1p: int,
     C: int,
     interpret: bool = False,
+    col0=None,  # [1, 1] int32 global first column (panel launches)
 ):
+    if col0 is None:
+        col0 = jnp.zeros((1, 1), jnp.int32)
     # lane count from the metadata, NOT the band: A_flat may carry extra
     # lane blocks (the fill kernel's combined fwd+rev output) that the
     # lane-block index simply never touches — avoiding a ~1 GB copy
@@ -243,6 +268,7 @@ def dense_call(
         functools.partial(_dense_kernel, K=K, C=C),
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
             lane_spec(),  # slen
@@ -274,7 +300,7 @@ def dense_call(
         ),
         interpret=interpret,
     )(
-        tlen_s, off_s,
+        tlen_s, off_s, jnp.asarray(col0, jnp.int32).reshape(1, 1),
         meta[0][None, None], meta[1][None, None], meta[2][None, None],
         A_flat[None],
         Bh,
@@ -305,6 +331,36 @@ def dense_tables_pallas(
     return tables[:, 1:5], tables[:, 5:9], tables[:, 0]
 
 
+def _moves_band(moves_flat, K: int, T1p: int, Npad: int):
+    """[n_steps*C*K, NBLK*128] int8 -> forward-stream [Npad, K, T1p]."""
+    nlanes = moves_flat.shape[1]
+    return moves_flat.reshape(T1p, K, nlanes).transpose(2, 1, 0)[:Npad]
+
+
+def stats_from_moves(moves, seq_lanes, template, geom: BandGeometry,
+                     lengths, K: int, off_override=None):
+    """Device traceback statistics over the Pallas move band: per-lane
+    alignment error counts and the union single-base-edit indicator table
+    (the Pallas counterpart of ops.fused's want_stats components).
+
+    ``moves`` is the uniform-frame forward move band [Npad, K, T1]
+    (T1 = template length + 1 — callers slice the fill's T1p columns so
+    the stats scan unrolls on the bucketed length); the scan itself is
+    align_jax._traceback_stats_one, which works unchanged because
+    uniform_geometry re-expresses the uniform frame in its per-read
+    terms. Padding lanes have all-NONE moves (their n_errors slot is -1;
+    callers slice to real reads) and contribute nothing to the union."""
+    from .align_jax import _traceback_stats_one
+    from .fill_pallas import uniform_geometry
+
+    ugeom = uniform_geometry(geom, lengths=lengths,
+                             off_override=off_override)
+    nerr, edits = jax.vmap(
+        _traceback_stats_one, in_axes=(0, 0, None, 0, None)
+    )(moves, seq_lanes, template, ugeom, K)
+    return nerr, jnp.max(edits, axis=0)
+
+
 def fused_tables_pallas(
     template,  # int8 [Tmax] padded template
     tlen,  # int32 true length
@@ -314,24 +370,32 @@ def fused_tables_pallas(
     K: int,
     T1p: int,
     C: int,
-    r_unique: Tuple[int, ...],
+    want_stats: bool = False,
+    want_moves: bool = False,
+    off_override=None,
+    slen_min=None,
     interpret: bool = False,
 ):
     """One hill-climb iteration's device work, all-Pallas: forward +
     backward fills (one launch), backward alignment, dense all-edits
-    tables — the Pallas counterpart of ops.fused.fused_step_full's
-    no-stats path. Returns device arrays
-    (total, scores [Npad], sub [T1p, 4], ins [T1p, 4], del [T1p])."""
+    tables, and (want_stats) traceback statistics from the in-kernel
+    move band — the Pallas counterpart of ops.fused.fused_step_full.
+    Returns a dict with total, scores [Npad], sub [T1p, 4], ins [T1p, 4],
+    del [T1p], plus n_errors [Npad] / edits [T1, 9] (want_stats) and the
+    forward move band [Npad, K, T1p] int8 (want_moves)."""
     from . import fill_pallas
 
     Npad = bufs.seq_T.shape[1]
     NB = Npad // LANES
+    need_moves = want_stats or want_moves
     p = fill_pallas.prepare_fill(
-        template, tlen, bufs, geom, K, T1p, C, with_backward=True
+        template, tlen, bufs, geom, K, T1p, C, with_backward=True,
+        off_override=off_override,
     )
-    band_flat, scores2 = fill_pallas._fill_call(
+    band_flat, scores2, moves_flat = fill_pallas._fill_call(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
-        K=K, T1p=T1p, NBLK=2 * NB, C=C, interpret=interpret,
+        K=K, T1p=T1p, NBLK=2 * NB, C=C, want_moves=need_moves,
+        interpret=interpret,
     )
     scores = scores2[0, :Npad]
 
@@ -339,7 +403,7 @@ def fused_tables_pallas(
     # the dense kernel reads the forward lanes of band_flat in place
     Bh = backward_halo_blocks(
         band_flat, jnp.asarray(tlen, jnp.int32), p["OFF"], bufs.lengths,
-        r_unique, K, T1p, C, lane0=Npad,
+        K, T1p, C, lane0=Npad, slen_min=slen_min,
     )
     A_flat = band_flat
 
@@ -354,34 +418,63 @@ def fused_tables_pallas(
         K, T1p, C, interpret=interpret,
     )
     total = jnp.sum(jnp.where(w > 0, scores, 0.0) * w)
-    return total, scores, sub_t, ins_t, del_t
+    out = {
+        "total": total, "scores": scores,
+        "sub": sub_t, "ins": ins_t, "del": del_t,
+    }
+    if need_moves:
+        moves = _moves_band(moves_flat, K, T1p, Npad)
+        if want_stats:
+            T1 = template.shape[0] + 1
+            nerr, edits = stats_from_moves(
+                moves[:, :, :T1], bufs.seq_T.T, template, geom,
+                bufs.lengths, K, off_override=off_override,
+            )
+            out["n_errors"] = nerr
+            out["edits"] = edits
+        if want_moves:
+            out["moves"] = moves
+    return out
 
 
 @functools.partial(
-    jax.jit, static_argnames=("K", "T1p", "C", "r_unique", "interpret")
+    jax.jit,
+    static_argnames=("K", "T1p", "C", "want_stats", "want_moves",
+                     "interpret"),
 )
 def fused_step_pallas(
     template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
-    K: int, T1p: int, C: int, r_unique: Tuple[int, ...],
+    K: int, T1p: int, C: int,
+    want_stats: bool = False, want_moves: bool = False,
     interpret: bool = False,
 ):
     """Packed-single-fetch wrapper of fused_tables_pallas (layout:
-    pack_layout_pallas)."""
-    total, scores, sub_t, ins_t, del_t = fused_tables_pallas(
-        template, tlen, bufs, geom, weights, K, T1p, C, r_unique,
-        interpret=interpret,
+    pack_layout_pallas). Returns (packed, moves-or-None)."""
+    out = fused_tables_pallas(
+        template, tlen, bufs, geom, weights, K, T1p, C,
+        want_stats=want_stats, want_moves=want_moves, interpret=interpret,
     )
-    return jnp.concatenate([
-        total[None],
-        scores,
-        sub_t.reshape(-1),
-        ins_t.reshape(-1),
-        del_t,
-    ])
+    return jnp.concatenate(pack_parts(out, want_stats)), out.get("moves")
 
 
-def pack_layout_pallas(Npad: int, T1p: int):
-    """Slice map of fused_step_pallas's packed array."""
+def pack_parts(out: dict, want_stats: bool):
+    """The packed-fetch section list, in pack_layout_pallas order — the
+    ONE place the producer-side order lives (fused_step_pallas, the
+    panel path, and the mesh wrapper all build from it; 'sub' and 'ins'
+    have identical sizes, so a divergent hand-built order would misread
+    silently, not shape-error)."""
+    parts = [out["total"][None], out["scores"]]
+    if want_stats:
+        parts.append(out["n_errors"].astype(jnp.float32))
+        parts.append(out["edits"].reshape(-1).astype(jnp.float32))
+    parts += [out["sub"].reshape(-1), out["ins"].reshape(-1), out["del"]]
+    return parts
+
+
+def pack_layout_pallas(Npad: int, T1p: int, want_stats: bool = False,
+                       T1: int = 0):
+    """Slice map of fused_step_pallas's packed array. ``T1`` (the
+    unpadded template length + 1) sizes the stats edit table."""
     out = {}
     o = 0
 
@@ -392,10 +485,50 @@ def pack_layout_pallas(Npad: int, T1p: int):
 
     take("total", 1)
     take("scores", Npad)
+    if want_stats:
+        take("n_errors", Npad)
+        take("edits", T1 * 9)
     take("sub", T1p * 4)
     take("ins", T1p * 4)
     take("del", T1p)
     return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "T1p", "C", "interpret")
+)
+def fill_stats_pallas(
+    template, tlen, bufs: FillBuffers, geom: BandGeometry,
+    K: int, T1p: int, C: int, off_override=None,
+    interpret: bool = False,
+):
+    """Bandwidth-adaptation round on the Pallas engine: forward-only fill
+    with in-kernel move recording, then the device traceback statistics —
+    no backward stream, no dense sweep (their outputs would be discarded
+    every round the bandwidths grow; the XLA path skips them via
+    want_tables=False for the same reason). Returns packed
+    [scores (Npad), n_errors (Npad)]."""
+    from . import fill_pallas
+
+    Npad = bufs.seq_T.shape[1]
+    NB = Npad // LANES
+    p = fill_pallas.prepare_fill(
+        template, tlen, bufs, geom, K, T1p, C, with_backward=False,
+        off_override=off_override,
+    )
+    _, scores2, moves_flat = fill_pallas._fill_call(
+        p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
+        K=K, T1p=T1p, NBLK=NB, C=C, want_moves=True, interpret=interpret,
+    )
+    moves = _moves_band(moves_flat, K, T1p, Npad)
+    T1 = template.shape[0] + 1
+    nerr, _ = stats_from_moves(
+        moves[:, :, :T1], bufs.seq_T.T, template, geom, bufs.lengths, K,
+        off_override=off_override,
+    )
+    return jnp.concatenate(
+        [scores2[0, :Npad], nerr.astype(jnp.float32)]
+    )
 
 
 def pick_dense_cols(T1p: int, K: int, vmem_budget: int = 9 << 20) -> int:
@@ -413,3 +546,198 @@ def pick_dense_cols(T1p: int, K: int, vmem_budget: int = 9 << 20) -> int:
                 best = c
         c *= 2
     return best
+
+
+# --- panel-blocked long-template path --------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "P", "C", "NB", "want_moves",
+                              "interpret")
+)
+def _panel_fill(
+    tlen_s, off_s, meta, placed, tpl_cols, col0, carry, score,
+    K: int, P: int, C: int, NB: int,
+    want_moves: bool = False, interpret: bool = False,
+):
+    """One panel's fill launch for one stream: slice the placed table
+    buffers at col0, halo-block the window, and run _fill_call with the
+    carry chained from the previous panel. Returns (band_flat [P*K, Npad],
+    score', moves-or-None, carry')."""
+    from . import fill_pallas
+
+    mt, mm, gi, dl, sq = placed
+    CB = C + K
+    n_steps = P // C
+    c0 = jnp.asarray(col0, jnp.int32)
+
+    def blk(buf):
+        win = jax.lax.dynamic_slice_in_dim(buf, c0, P + K, axis=0)
+        return fill_pallas._block_tables(win, n_steps, C, CB)
+
+    t_cols = jax.lax.dynamic_slice_in_dim(tpl_cols, c0, P)[None]
+    band, score2, moves, carry2 = fill_pallas._fill_call(
+        tlen_s, off_s, t_cols, meta,
+        blk(mt), blk(mm), blk(gi), blk(dl), blk(sq),
+        K=K, T1p=P, NBLK=NB, C=C, want_moves=want_moves,
+        col0=jnp.reshape(c0, (1, 1)), carry_in=carry, score_in=score,
+        interpret=interpret,
+    )
+    return band, score2, moves, carry2
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("K", "P", "C", "NB", "T1p_pad", "interpret"),
+)
+def _panel_dense(
+    tlen_s, off_s, meta3, placed_fwd, band_fwd, Brev_flat, weights,
+    col0, jb0,
+    K: int, P: int, C: int, NB: int, T1p_pad: int,
+    interpret: bool = False,
+):
+    """One panel's dense step: halo-block the panel's backward columns
+    from the full Brev band, then run the dense kernel on the panel's
+    forward band. Returns (sub [P, 4], ins [P, 4], del [P])."""
+    from . import fill_pallas
+
+    mt, mm, gi, dl, sq = placed_fwd
+    CB = C + K
+    n_steps = P // C
+    c0 = jnp.asarray(col0, jnp.int32)
+
+    def blk(buf):
+        win = jax.lax.dynamic_slice_in_dim(buf, c0, P + K, axis=0)
+        return fill_pallas._block_tables(win, n_steps, C, CB)
+
+    Npad = meta3.shape[1]
+    Bh = backward_halo_blocks(
+        Brev_flat, tlen_s[0, 0], off_s[0, 0], meta3[0],
+        K, T1p_pad, C, jb0=jb0, n_blocks=n_steps,
+    )
+    per_lane = dense_call(
+        tlen_s, off_s, meta3, band_fwd, Bh,
+        blk(mt), blk(mm), blk(gi), blk(dl), blk(sq),
+        K=K, T1p=P, C=C, col0=jnp.reshape(c0, (1, 1)),
+        interpret=interpret,
+    )
+    w = weights[None, None, :]
+    tables = jnp.sum(jnp.where(w > 0, per_lane, 0.0) * w, axis=2)
+    return tables[:, 1:5], tables[:, 5:9], tables[:, 0]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_panel(buf, panel, row0):
+    """Write one panel's flat rows into the full-band buffer in place
+    (donation makes the update alias the input across the dispatch)."""
+    return jax.lax.dynamic_update_slice(
+        buf, panel.astype(buf.dtype), (row0, jnp.int32(0))
+    )
+
+
+def fused_tables_pallas_panels(
+    template,  # int8 [Tmax]
+    tlen,  # int32
+    bufs: FillBuffers,
+    geom: BandGeometry,
+    weights,
+    K: int,
+    T1p: int,
+    C: int,
+    panel_cols: int,
+    want_stats: bool = False,
+    want_moves: bool = False,
+    interpret: bool = False,
+):
+    """The fused step for templates whose single-launch working set
+    exceeds HBM: the reversed stream fills the FULL band first (it must
+    be complete before any forward panel's dense join), then forward
+    panels of ``panel_cols`` columns stream left-to-right — each panel
+    launch chains the DP carry from the previous one, computes its dense
+    all-edit table slice against the halo-blocked backward columns, and
+    is then discarded. Peak HBM is the full Brev band plus O(panel)
+    temporaries instead of two full bands plus their halo'd copies.
+    Same contract as fused_tables_pallas (dict)."""
+    from . import fill_pallas
+
+    Npad = bufs.seq_T.shape[1]
+    NB = Npad // LANES
+    P = panel_cols
+    T1p_pad = ((T1p + P - 1) // P) * P
+    n_panels = T1p_pad // P
+    pp = fill_pallas.prepare_fill_panels(
+        template, tlen, bufs, geom, K, T1p_pad
+    )
+    tlen_s, off_s, meta = pp["tlen_s"], pp["off_s"], pp["meta"]
+    need_moves = want_stats or want_moves
+
+    # phase 1: full reversed-problem band. Panels are written into a
+    # PREALLOCATED buffer with donation — collecting panels and
+    # concatenating would double the peak (full band + its copy), which
+    # is exactly the headroom long templates do not have.
+    carry = jnp.zeros((K, Npad), jnp.float32)
+    score = jnp.full((1, Npad), NEG_INF, jnp.float32)
+    Brev_flat = jnp.zeros((T1p_pad * K, Npad), jnp.float32)
+    for p in range(n_panels):
+        band, score, _, carry = _panel_fill(
+            tlen_s, off_s, meta, pp["rev_placed"], pp["rtpl_cols"],
+            jnp.int32(p * P), carry, score,
+            K=K, P=P, C=C, NB=NB, want_moves=False, interpret=interpret,
+        )
+        Brev_flat = _write_panel(Brev_flat, band, jnp.int32(p * P * K))
+
+    # phase 2: forward panels + dense slices
+    meta3 = jnp.stack([
+        bufs.lengths,
+        _pad_lanes(geom.offset.astype(jnp.int32), Npad),
+        _pad_lanes(geom.bandwidth.astype(jnp.int32), Npad),
+    ])
+    w = _pad_lanes(weights.astype(jnp.float32), Npad)
+    carry = jnp.zeros((K, Npad), jnp.float32)
+    score = jnp.full((1, Npad), NEG_INF, jnp.float32)
+    subs, inss, dels_t = [], [], []
+    moves_flat = (
+        jnp.zeros((T1p_pad * K, Npad), jnp.int8) if need_moves else None
+    )
+    for p in range(n_panels):
+        band, score, mv, carry = _panel_fill(
+            tlen_s, off_s, meta, pp["fwd_placed"], pp["tpl_cols"],
+            jnp.int32(p * P), carry, score,
+            K=K, P=P, C=C, NB=NB, want_moves=need_moves,
+            interpret=interpret,
+        )
+        sub_p, ins_p, del_p = _panel_dense(
+            tlen_s, off_s, meta3, pp["fwd_placed"], band, Brev_flat, w,
+            jnp.int32(p * P), jnp.int32(p * (P // C)),
+            K=K, P=P, C=C, NB=NB, T1p_pad=T1p_pad,
+            interpret=interpret,
+        )
+        subs.append(sub_p)
+        inss.append(ins_p)
+        dels_t.append(del_p)
+        if need_moves:
+            moves_flat = _write_panel(
+                moves_flat, mv, jnp.int32(p * P * K)
+            )
+    scores = score[0]
+    total = jnp.sum(jnp.where(w > 0, scores, 0.0) * w)
+    out = {
+        "total": total,
+        "scores": scores,
+        "sub": jnp.concatenate(subs)[:T1p],
+        "ins": jnp.concatenate(inss)[:T1p],
+        "del": jnp.concatenate(dels_t)[:T1p],
+    }
+    if need_moves:
+        moves = _moves_band(moves_flat, K, T1p_pad, Npad)
+        if want_stats:
+            T1 = template.shape[0] + 1
+            nerr, edits = stats_from_moves(
+                moves[:, :, :T1], bufs.seq_T.T, template, geom,
+                bufs.lengths, K,
+            )
+            out["n_errors"] = nerr
+            out["edits"] = edits
+        if want_moves:
+            out["moves"] = moves
+    return out

@@ -5,13 +5,13 @@ Second-generation Pallas engine for the reference's hot inner loop
 pays per-column kernel-launch overhead — ~75 ms for a merged
 forward+backward fill at 1 kb x 256 reads where the arithmetic is
 worth ~1 ms (round-4 profile) — and the first-generation kernel
-(align_pallas) iterated ONE column per sequential grid step, losing to
+(exp/align_pallas_gen1.py) iterated ONE column per sequential grid step, losing to
 that same overhead ~100x. This kernel keeps the whole column sweep
 on-core:
 
 - **Uniform band frame.** The first-generation kernel placed each
   read's band at its own diagonal offset, so score tables had to be
-  pre-shifted per read on the host (align_pallas._prep_tables) and
+  pre-shifted per read on the host (the gen-1 kernel's _prep_tables) and
   re-uploaded every call. Here every read shares ONE frame: data row d
   of column j holds cell ``i = d + j - OFF`` with a single batch-wide
   ``OFF = max_k(offset_k)``; each read keeps its own band LIMITS as a
@@ -46,10 +46,20 @@ on-core:
   template for them. The reversed-problem output is flipped back to
   backward-band layout by the XLA helper `flip_reversed_uniform`.
 
-Used for score-only fills (the hill-climb hot path). The moves-recording
-variant (SCORE-stage tracebacks, device traceback statistics) stays on
-the XLA path, as does any batch whose uniform-frame K would blow up
-(pathological read-length spread) — see engine.realign for the policy.
+- **Optional in-kernel move recording** (want_moves): the kernel emits
+  the per-cell traceback codes alongside the fill, so bandwidth
+  adaptation, alignment-derived proposals (device stats over the move
+  band), and SCORE-stage host tracebacks all ride the on-core engine.
+
+- **Panel chaining** (col0/carry_in/carry_out): a launch may cover only
+  a panel of template columns, chaining the DP carry and score
+  accumulator from the previous panel — the long-template mode
+  (ops.dense_pallas.fused_tables_pallas_panels) that keeps 30 kb+
+  working sets inside HBM.
+
+Batches whose uniform-frame K would blow up (pathological read-length
+spread) stay on the XLA path — see engine.realign._pallas_mode for the
+policy.
 """
 
 from __future__ import annotations
@@ -64,10 +74,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .align_jax import BandGeometry
+from .align_np import (
+    TRACE_DELETE,
+    TRACE_INSERT,
+    TRACE_MATCH,
+    TRACE_NONE,
+)
 
 # finite sentinel: avoids -inf arithmetic on the VPU (inf - inf = nan in
 # the chain's cand - G); half of float32 min keeps all sums finite
 NEG_INF = float(np.finfo(np.float32).min) / 2
+# liveness threshold for move recording: real DP values are bounded by
+# ~#cells * min-score (~1e6 magnitude), unreachable cells sit near NEG_INF
+NEG_LIVE = NEG_INF / 2
 
 LANES = 128
 
@@ -77,6 +96,29 @@ def uniform_frame(geom: BandGeometry):
     OFF = jnp.max(geom.offset)
     delta = OFF - geom.offset
     return OFF, delta, geom.nd
+
+
+def uniform_geometry(geom: BandGeometry, lengths=None,
+                     off_override=None) -> BandGeometry:
+    """A BandGeometry whose frame matches the uniform band layout: every
+    read gets ``offset = OFF`` (so ``d = i - j + OFF``) and a doctored
+    bandwidth such that the derived traceback end row
+    ``max(slen - tlen, 0) + bandwidth`` equals the uniform frame's
+    ``dend = slen - tlen + OFF``. Consumers of the Pallas move band
+    (align_jax._traceback_stats_one / traceback_batch) then work
+    unchanged. ``lengths`` overrides geom.slen (lane-padded batches);
+    ``off_override`` pins OFF (sharded meshes use the global maximum so
+    every shard shares one frame)."""
+    slen = geom.slen if lengths is None else jnp.asarray(lengths, jnp.int32)
+    OFF = jnp.max(geom.offset) if off_override is None else (
+        jnp.asarray(off_override, jnp.int32)
+    )
+    tlen = jnp.broadcast_to(geom.tlen.reshape(-1)[0], slen.shape)
+    offset = jnp.broadcast_to(OFF, slen.shape)
+    bw = OFF - jnp.maximum(tlen - slen, 0)
+    nd = (OFF - geom.offset) + geom.nd
+    nd = jnp.broadcast_to(jnp.max(nd), slen.shape)
+    return BandGeometry(slen, tlen, bw, offset, nd)
 
 
 def uniform_band_height(geom_host_offsets, geom_host_nd, mult: int = 8) -> int:
@@ -103,7 +145,8 @@ def _fill_kernel(
     # SMEM inputs
     tlen_ref,  # [1, 1] true template length
     off_ref,  # [1, 1] uniform frame offset OFF
-    t_ref,  # [n_tpl, T1p] template codes per stream
+    col0_ref,  # [1, 1] global column of this launch's first column
+    t_ref,  # [n_tpl, n_cols] template codes per stream (LOCAL columns)
     # per-lane metadata, [1, 1, 128] blocks
     slen_ref,
     delta_ref,
@@ -115,21 +158,34 @@ def _fill_kernel(
     gi_ref,
     dl_ref,
     sq_ref,
-    # outputs
-    out_ref,  # VMEM [C * K, 128] band columns of this step
-    score_ref,  # VMEM [1, 128] final scores (written on the last step)
-    # scratch
-    carry,  # VMEM [K, 128] previous column
-    acc_score,  # VMEM [1, 128]
-    *,
+    # with has_carry: carry_in [K, 128] and score_in [1, 128] inputs
+    # (the previous panel's final column / score accumulator)
+    # outputs: out_ref [C * K, 128] band columns, score_ref [1, 128]
+    # final scores (last step), then mv_ref [C * K, 128] int32 move codes
+    # when want_moves, then carry_out [K, 128] when has_carry; scratch:
+    # carry [K, 128] previous column, acc_score [1, 128]
+    *refs,
     K: int,
     C: int,
     blocks_per_tpl: int,
+    want_moves: bool = False,
+    has_carry: bool = False,
 ):
+    refs = list(refs)
+    carry_in = score_in = None
+    if has_carry:
+        carry_in = refs.pop(0)
+        score_in = refs.pop(0)
+    out_ref = refs.pop(0)
+    score_ref = refs.pop(0)
+    mv_ref = refs.pop(0) if want_moves else None
+    carry_out = refs.pop(0) if has_carry else None
+    carry, acc_score = refs
     jb = pl.program_id(1)
     stream = pl.program_id(0) // blocks_per_tpl
     tlen = tlen_ref[0, 0]
     OFF = off_ref[0, 0]
+    col0 = col0_ref[0, 0]
 
     slen = slen_ref[0, 0, :]
     delta = delta_ref[0, 0, :]
@@ -140,11 +196,15 @@ def _fill_kernel(
 
     @pl.when(jb == 0)
     def _():
-        acc_score[:] = jnp.full((1, LANES), NEG_INF, jnp.float32)
+        if has_carry:
+            carry[:] = carry_in[:]
+            acc_score[:] = score_in[:]
+        else:
+            acc_score[:] = jnp.full((1, LANES), NEG_INF, jnp.float32)
 
     prev = carry[:]
     for c in range(C):
-        j = jb * C + c
+        j = col0 + jb * C + c
         i = d + (j - OFF)
         valid = (i >= 0) & (i <= slen[None, :]) & in_lane_band & (j <= tlen)
 
@@ -155,7 +215,9 @@ def _fill_kernel(
         dlw = dl_ref[0, c : c + K, :]
         sqw = sq_ref[0, c : c + K, :]
 
-        tb = t_ref[stream, j]  # template base of column j (junk at j == 0)
+        # template base of column j (junk at j == 0); t_ref holds only
+        # this launch's columns, so index locally
+        tb = t_ref[stream, jb * C + c]
 
         # j == 0: only cell (0, 0) seeds the recurrence
         first = j == 0
@@ -176,6 +238,38 @@ def _fill_kernel(
         F = G + _cumop(cand - G, jnp.maximum, K)
         F = jnp.where(valid, F, neg)
 
+        if want_moves:
+            # move codes from the same candidates the fill used, with the
+            # reference tie-break priority match > insert > delete
+            # (align.jl:78-86; identical to align_jax._scan_fill's argmax
+            # over [mcand, icand, dcand]). Finite-sentinel note: when both
+            # mcand and dcand derive from out-of-band predecessors their
+            # NEG-offset values differ from the XLA path's -inf ties, but
+            # every such divergence is confined to cells whose F stays
+            # near NEG_INF — masked to TRACE_NONE by the liveness test in
+            # both engines (see tests/test_fill_dense_pallas.py moves
+            # equality).
+            icand = pltpu.roll(F, 1, axis=0)
+            icand = jnp.where(d == 0, neg, icand) + g
+            mv = jnp.where(
+                (mcand >= icand) & (mcand >= dcand),
+                TRACE_MATCH,
+                jnp.where(icand >= dcand, TRACE_INSERT, TRACE_DELETE),
+            )
+            live = valid & (F > NEG_LIVE)
+            mv = jnp.where(
+                first,
+                jnp.where((i > 0) & live, TRACE_INSERT, TRACE_NONE),
+                jnp.where(live, mv, TRACE_NONE),
+            )
+
+            # only the forward stream's moves are ever consumed; skipping
+            # the reversed lanes halves the move-band write traffic (the
+            # rev half of the output stays uninitialized garbage)
+            @pl.when(stream == 0)
+            def _():
+                mv_ref[c * K : (c + 1) * K, :] = mv.astype(jnp.int32)
+
         prev = F
         out_ref[c * K : (c + 1) * K, :] = F
 
@@ -190,18 +284,22 @@ def _fill_kernel(
     @pl.when(jb == pl.num_programs(1) - 1)
     def _():
         score_ref[:] = acc_score[:]
+        if has_carry:
+            carry_out[:] = prev
 
 
-def _pick_cols(T1p: int, K: int, vmem_budget: int = 9 << 20) -> int:
+def _pick_cols(T1p: int, K: int, vmem_budget: int = 9 << 20,
+               want_moves: bool = False) -> int:
     """Columns per grid step: the largest divisor of T1p whose working
-    set (double-buffered output block [C*K, 128] f32 + 5 double-buffered
-    table blocks [C+K, 128]) fits the VMEM budget. T1p is a multiple of
-    64 for bucketed templates."""
+    set (double-buffered output block [C*K, 128] f32 — twice that with a
+    move-band output — + 5 double-buffered table blocks [C+K, 128]) fits
+    the VMEM budget. T1p is a multiple of 64 for bucketed templates."""
+    out_blocks = 2 if want_moves else 1
     best = 1
     c = 1
     while c <= min(T1p, 512):
         if T1p % c == 0:
-            need = 2 * 128 * 4 * (c * K + 5 * (c + K))
+            need = 2 * 128 * 4 * (out_blocks * c * K + 5 * (c + K))
             if need <= vmem_budget:
                 best = c
         c *= 2
@@ -209,7 +307,8 @@ def _pick_cols(T1p: int, K: int, vmem_budget: int = 9 << 20) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("K", "T1p", "NBLK", "C", "interpret")
+    jax.jit,
+    static_argnames=("K", "T1p", "NBLK", "C", "want_moves", "interpret"),
 )
 def _fill_call(
     tlen_s,  # [1, 1] int32
@@ -221,12 +320,19 @@ def _fill_call(
     T1p: int,
     NBLK: int,
     C: int,
+    want_moves: bool = False,
     interpret: bool = False,
+    col0=None,  # [1, 1] int32 global first column (panel launches)
+    carry_in=None,  # [K, NBLK*128] previous panel's final column
+    score_in=None,  # [1, NBLK*128] previous panel's score accumulator
 ):
     n_steps = T1p // C
     CB = mt.shape[1]
     n_tpl = t_cols.shape[0]
     blocks_per_tpl = NBLK // n_tpl
+    has_carry = carry_in is not None
+    if col0 is None:
+        col0 = jnp.zeros((1, 1), jnp.int32)
 
     grid = (NBLK, n_steps)
 
@@ -243,44 +349,87 @@ def _fill_call(
         )
 
     kernel = functools.partial(
-        _fill_kernel, K=K, C=C, blocks_per_tpl=blocks_per_tpl
+        _fill_kernel, K=K, C=C, blocks_per_tpl=blocks_per_tpl,
+        want_moves=want_moves, has_carry=has_carry,
     )
 
-    out_band, scores = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
-            # whole template table (TPU SMEM blocks must span the trailing
-            # dims); the kernel indexes [stream, column] dynamically
-            pl.BlockSpec(
-                (n_tpl, T1p), lambda nb, jb: (0, 0),
-                memory_space=pltpu.SMEM,
-            ),
-            lane_spec(),  # slen
-            lane_spec(),  # delta
-            lane_spec(),  # nd
-            lane_spec(),  # dend
-            tab_spec(),  # mt
-            tab_spec(),  # mm
-            tab_spec(),  # gi
-            tab_spec(),  # dl
-            tab_spec(),  # sq
-        ],
-        out_specs=[
+    out_specs = [
+        pl.BlockSpec(
+            (C * K, LANES), lambda nb, jb: (jb, nb),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, LANES), lambda nb, jb: (0, nb), memory_space=pltpu.VMEM
+        ),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((n_steps * C * K, NBLK * LANES), jnp.float32),
+        jax.ShapeDtypeStruct((1, NBLK * LANES), jnp.float32),
+    ]
+    if want_moves:
+        out_specs.append(
             pl.BlockSpec(
                 (C * K, LANES), lambda nb, jb: (jb, nb),
                 memory_space=pltpu.VMEM,
-            ),
+            )
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((n_steps * C * K, NBLK * LANES), jnp.int32)
+        )
+    if has_carry:
+        out_specs.append(
+            pl.BlockSpec(
+                (K, LANES), lambda nb, jb: (0, nb), memory_space=pltpu.VMEM
+            )
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((K, NBLK * LANES), jnp.float32)
+        )
+
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+        # whole template table (TPU SMEM blocks must span the trailing
+        # dims); the kernel indexes [stream, column] dynamically
+        pl.BlockSpec(
+            (n_tpl, t_cols.shape[1]), lambda nb, jb: (0, 0),
+            memory_space=pltpu.SMEM,
+        ),
+        lane_spec(),  # slen
+        lane_spec(),  # delta
+        lane_spec(),  # nd
+        lane_spec(),  # dend
+        tab_spec(),  # mt
+        tab_spec(),  # mm
+        tab_spec(),  # gi
+        tab_spec(),  # dl
+        tab_spec(),  # sq
+    ]
+    args = [
+        tlen_s, off_s, jnp.asarray(col0, jnp.int32).reshape(1, 1), t_cols,
+        meta[0][None], meta[1][None], meta[2][None], meta[3][None],
+        mt, mm, gi, dl, sq,
+    ]
+    if has_carry:
+        in_specs.append(
+            pl.BlockSpec(
+                (K, LANES), lambda nb, jb: (0, nb), memory_space=pltpu.VMEM
+            )
+        )
+        in_specs.append(
             pl.BlockSpec(
                 (1, LANES), lambda nb, jb: (0, nb), memory_space=pltpu.VMEM
-            ),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_steps * C * K, NBLK * LANES), jnp.float32),
-            jax.ShapeDtypeStruct((1, NBLK * LANES), jnp.float32),
-        ],
+            )
+        )
+        args += [carry_in, score_in]
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((K, LANES), jnp.float32),
             pltpu.VMEM((1, LANES), jnp.float32),
@@ -289,12 +438,15 @@ def _fill_call(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(
-        tlen_s, off_s, t_cols,
-        meta[0][None], meta[1][None], meta[2][None], meta[3][None],
-        mt, mm, gi, dl, sq,
-    )
-    return out_band, scores
+    )(*args)
+    outs = list(outs)
+    out_band = outs.pop(0)
+    scores = outs.pop(0)
+    moves = outs.pop(0).astype(jnp.int8) if want_moves else None
+    if has_carry:
+        carry_out = outs.pop(0)
+        return out_band, scores, moves, carry_out
+    return out_band, scores, moves
 
 
 def _block_tables(buf, n_steps: int, C: int, CB: int):
@@ -383,18 +535,24 @@ def prepare_fill(
     T1p: int,
     C: int,
     with_backward: bool = True,
+    off_override=None,
 ):
     """Build every _fill_call input: frame scalars, per-lane metadata,
     template column tables, and the halo-blocked score tables for the
     forward (and optionally reversed) stream. Returns a dict; the
     forward-stream blocked tables ride along for reuse by the dense
-    kernel (ops.dense_pallas), which consumes the identical layout."""
+    kernel (ops.dense_pallas), which consumes the identical layout.
+    ``off_override`` pins the frame offset OFF (sharded meshes pass the
+    global maximum so all shards share one frame)."""
     Npad = bufs.seq_T.shape[1]
     n_steps = T1p // C
     CB = C + K
 
     tlen = jnp.asarray(tlen, jnp.int32)
-    OFF = jnp.max(geom.offset).astype(jnp.int32)
+    OFF = (
+        jnp.max(geom.offset).astype(jnp.int32) if off_override is None
+        else jnp.asarray(off_override, jnp.int32)
+    )
     delta = _pad_lanes((OFF - geom.offset).astype(jnp.int32), Npad)
     ndv = _pad_lanes(geom.nd.astype(jnp.int32), Npad)
     slen = bufs.lengths
@@ -478,7 +636,83 @@ def prepare_fill(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("K", "T1p", "C", "with_backward", "interpret")
+    jax.jit, static_argnames=("K", "T1p_pad")
+)
+def prepare_fill_panels(
+    template,  # int8 [Tmax] padded template
+    tlen,  # int32 true length
+    bufs: FillBuffers,
+    geom: BandGeometry,
+    K: int,
+    T1p_pad: int,  # panelized column count (multiple of the panel size)
+    off_override=None,
+):
+    """Panel-mode fill inputs: the PLACED (padded, un-blocked) forward
+    and reversed table buffers plus frame scalars/metadata. Panels slice
+    buffer rows [col0, col0 + P + K) per launch instead of materializing
+    the fully blocked tables (whose halo'd copy is what breaks the HBM
+    budget at very long templates)."""
+    Npad = bufs.seq_T.shape[1]
+    tlen = jnp.asarray(tlen, jnp.int32)
+    OFF = (
+        jnp.max(geom.offset).astype(jnp.int32) if off_override is None
+        else jnp.asarray(off_override, jnp.int32)
+    )
+    delta = _pad_lanes((OFF - geom.offset).astype(jnp.int32), Npad)
+    ndv = _pad_lanes(geom.nd.astype(jnp.int32), Npad)
+    slen = bufs.lengths
+    dend = slen - tlen + OFF
+
+    L = bufs.seq_T.shape[0]
+    Lbuf = T1p_pad + K + 8
+    Lbig = Lbuf + L
+
+    def place(tab_T, row0, fill):
+        buf = jnp.full((Lbig, Npad), fill, tab_T.dtype)
+        buf = jax.lax.dynamic_update_slice(
+            buf, tab_T, (row0.astype(jnp.int32), jnp.int32(0))
+        )
+        return buf[:Lbuf]
+
+    def placed(sqT, mtT, mmT, giT, dlT):
+        return (
+            place(mtT, OFF + 1, 0.0),
+            place(mmT, OFF + 1, 0.0),
+            place(giT, OFF + 1, 0.0),
+            place(dlT, OFF, 0.0),
+            place(sqT, OFF + 1, -9),
+        )
+
+    def to_cols(t):
+        cols = jnp.concatenate([t[:1], t]).astype(jnp.int32)
+        return jnp.pad(cols, (0, T1p_pad - cols.shape[0]))
+
+    k = jnp.arange(template.shape[0])
+    ridx = jnp.clip(tlen - 1 - k, 0, template.shape[0] - 1)
+    rtemplate = jnp.where(k < tlen, template[ridx], template[k])
+
+    return {
+        "tlen_s": jnp.reshape(tlen, (1, 1)),
+        "off_s": jnp.reshape(OFF, (1, 1)),
+        "OFF": OFF,
+        "tpl_cols": to_cols(template),
+        "rtpl_cols": to_cols(rtemplate),
+        "meta": jnp.stack([m[None] for m in (slen, delta, ndv, dend)]),
+        "fwd_placed": placed(
+            bufs.seq_T, bufs.match_T, bufs.mismatch_T, bufs.ins_T,
+            bufs.dels_T,
+        ),
+        "rev_placed": placed(
+            bufs.rseq_T, bufs.rmatch_T, bufs.rmismatch_T, bufs.rins_T,
+            bufs.rdels_T,
+        ),
+    }
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("K", "T1p", "C", "with_backward", "want_moves",
+                     "interpret"),
 )
 def fill_uniform(
     template,  # int8 [Tmax] padded template
@@ -489,32 +723,42 @@ def fill_uniform(
     T1p: int,
     C: int = 0,
     with_backward: bool = True,
+    want_moves: bool = False,
     interpret: bool = False,
 ):
     """Pallas banded fill in the uniform frame.
 
-    Returns (A [N, K, T1p], Brev or None, scores [N], OFF) where A is the
-    forward band, Brev the RAW reversed-problem forward band (flip to
-    backward layout with flip_reversed_uniform), and scores[k] =
-    A[dend_k, tlen]. N = lane count (callers slice off padding lanes).
+    Returns (A [N, K, T1p], Brev or None, scores [N], OFF, moves or None)
+    where A is the forward band, Brev the RAW reversed-problem forward
+    band (flip to backward layout with flip_reversed_uniform), scores[k]
+    = A[dend_k, tlen], and moves the forward-stream move band
+    [N, K, T1p] int8 (uniform frame; pair with uniform_geometry for
+    consumers). N = lane count (callers slice off padding lanes).
     """
     Npad = bufs.seq_T.shape[1]
     NB = Npad // LANES
     if C <= 0:
-        C = _pick_cols(T1p, K)
+        C = _pick_cols(T1p, K, want_moves=want_moves)
     p = prepare_fill(template, tlen, bufs, geom, K, T1p, C, with_backward)
     NBLK = 2 * NB if with_backward else NB
-    band_flat, scores = _fill_call(
+    band_flat, scores, moves_flat = _fill_call(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
-        K=K, T1p=T1p, NBLK=NBLK, C=C, interpret=interpret,
+        K=K, T1p=T1p, NBLK=NBLK, C=C, want_moves=want_moves,
+        interpret=interpret,
     )
     # [n_steps*C*K, NBLK*128] -> [T1p, K, NBLK*128] -> [lanes, K, T1p]
     band = band_flat.reshape(T1p, K, NBLK * LANES).transpose(2, 1, 0)
     A = band[:Npad]
+    moves = None
+    if want_moves:
+        moves = (
+            moves_flat.reshape(T1p, K, NBLK * LANES)
+            .transpose(2, 1, 0)[:Npad]
+        )
     if with_backward:
         Brev = band[Npad:]
-        return A, Brev, scores[0, :Npad], p["OFF"]
-    return A, None, scores[0, :Npad], p["OFF"]
+        return A, Brev, scores[0, :Npad], p["OFF"], moves
+    return A, None, scores[0, :Npad], p["OFF"], moves
 
 
 @functools.partial(jax.jit, static_argnames=("K",))

@@ -20,6 +20,7 @@ mesh, annotate shardings, let XLA insert collectives.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -119,6 +120,180 @@ def _consensus_step(
     total = weighted_read_sum(weights, scores)
     proposal_totals = weighted_read_sum(weights, pscores)
     return total, proposal_totals
+
+
+# --- mesh-sharded Pallas engine ------------------------------------------
+#
+# GSPMD cannot partition a pallas_call, so the Pallas fill+dense step runs
+# under shard_map: each shard fills its local reads' bands with the
+# on-core kernel, and the cross-shard reductions (total score, dense
+# all-edit tables, edit-indicator unions) are explicit psum/pmax over the
+# read axis — the same collectives XLA inserts for the fused XLA path.
+# One subtlety: the uniform band frame must be GLOBAL (one OFF for every
+# shard, computed by pmax) so the band layout, the static K, and the
+# host-side traceback geometry agree across chips.
+
+
+def mesh_fill_buffers(mesh: Mesh, batch: ReadBatch, Npad_local: int):
+    """Per-shard FillBuffers (ops.fill_pallas) built under shard_map from
+    a read-sharded batch; the returned (global-view) buffers keep their
+    lane axis sharded with Npad_local lanes per device."""
+    from jax import shard_map
+
+    from ..ops.fill_pallas import FillBuffers, build_fill_buffers
+
+    def local(seq, match, mismatch, ins, dels, lengths):
+        return build_fill_buffers(
+            seq, match, mismatch, ins, dels, lengths, Npad_local
+        )
+
+    lanes2 = P(None, READS_AXIS)
+    out_specs = FillBuffers(
+        seq_T=lanes2, match_T=lanes2, mismatch_T=lanes2, ins_T=lanes2,
+        dels_T=lanes2, rseq_T=lanes2, rmatch_T=lanes2, rmismatch_T=lanes2,
+        rins_T=lanes2, rdels_T=lanes2, lengths=P(READS_AXIS),
+    )
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(READS_AXIS, None),) * 5 + (P(READS_AXIS),),
+        out_specs=out_specs,
+    )
+    return fn(
+        batch.seq, batch.match, batch.mismatch, batch.ins, batch.dels,
+        batch.lengths,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "K", "T1p", "C", "want_stats",
+                     "want_moves", "interpret"),
+)
+def mesh_fused_step_pallas(
+    mesh: Mesh,
+    template,  # int8 [Tmax] (replicated)
+    tlen,  # int32
+    bufs,  # FillBuffers, lane axis sharded (mesh_fill_buffers)
+    lengths,  # [Nglobal] int32, read-sharded (pre-lane-padding)
+    bandwidths,  # [Nglobal] int32, read-sharded
+    weights,  # [Nglobal] f32, read-sharded ({0,1} padding mask)
+    K: int,
+    T1p: int,
+    C: int,
+    want_stats: bool = False,
+    want_moves: bool = False,
+    interpret: bool = False,
+):
+    """The Pallas fused step over a read-sharded mesh: per-shard on-core
+    fill + dense tables, cross-shard psum/pmax reductions. Returns
+    (packed, moves-or-None); packed follows pack_layout_pallas with
+    Npad = n_devices * Npad_local (per-shard lane padding preserved —
+    map read r to slot (r // Nlocal) * Npad_local + r % Nlocal)."""
+    from jax import shard_map
+
+    from ..ops.dense_pallas import fused_tables_pallas
+
+    def local(t, tl, bufs_l, lens_l, bw_l, w_l):
+        from ..ops.dense_pallas import pack_parts
+
+        geom = BandGeometry.make(lens_l, tl, bw_l)
+        OFF_g = jax.lax.pmax(jnp.max(geom.offset), READS_AXIS)
+        sl = bufs_l.lengths
+        slen_min_g = jax.lax.pmin(
+            jnp.min(jnp.where(sl > 0, sl, jnp.int32(2**30))), READS_AXIS
+        )
+        out = fused_tables_pallas(
+            t, tl, bufs_l, geom, w_l, K, T1p, C,
+            want_stats=want_stats, want_moves=want_moves,
+            off_override=OFF_g, slen_min=slen_min_g, interpret=interpret,
+        )
+        # cross-shard reductions, then the SHARED section order
+        out = dict(
+            out,
+            total=jax.lax.psum(out["total"], READS_AXIS),
+            sub=jax.lax.psum(out["sub"], READS_AXIS),
+            ins=jax.lax.psum(out["ins"], READS_AXIS),
+            **{"del": jax.lax.psum(out["del"], READS_AXIS)},
+        )
+        if want_stats:
+            out["edits"] = jax.lax.pmax(
+                out["edits"].astype(jnp.float32), READS_AXIS
+            )
+        parts = pack_parts(out, want_stats)
+        moves = out.get("moves")
+        if moves is None:
+            moves = jnp.zeros((0, 0, 0), jnp.int8)
+        return tuple(parts), moves
+
+    n_parts = 2 + (2 if want_stats else 0) + 3
+    # per-shard packed sections: scalars and tables are replicated after
+    # the collectives; per-read vectors stay sharded
+    rep = P()
+    shard = P(READS_AXIS)
+    part_specs = [rep, shard]
+    if want_stats:
+        part_specs += [shard, rep]
+    part_specs += [rep, rep, rep]
+    assert len(part_specs) == n_parts
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(
+            P(), P(),
+            jax.tree_util.tree_map(lambda _: P(None, READS_AXIS), bufs)._replace(
+                lengths=P(READS_AXIS)
+            ),
+            shard, shard, shard,
+        ),
+        out_specs=(tuple(part_specs), P(READS_AXIS, None, None)),
+        # pallas_call has no varying-manual-axes annotations; the
+        # collectives above establish the replication invariants instead
+        check_vma=False,
+    )
+    parts, moves = fn(template, tlen, bufs, lengths, bandwidths, weights)
+    packed = jnp.concatenate(list(parts))
+    return packed, (moves if want_moves else None)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "K", "T1p", "C", "interpret"),
+)
+def mesh_fill_stats_pallas(
+    mesh: Mesh, template, tlen, bufs, lengths, bandwidths,
+    K: int, T1p: int, C: int, interpret: bool = False,
+):
+    """Sharded adaptation round: per-shard forward-only Pallas fill with
+    move recording + traceback stats. Returns packed
+    [scores (Npad), n_errors (Npad)] with the per-shard lane layout of
+    mesh_fused_step_pallas."""
+    from jax import shard_map
+
+    from ..ops.dense_pallas import fill_stats_pallas
+
+    def local(t, tl, bufs_l, lens_l, bw_l):
+        geom = BandGeometry.make(lens_l, tl, bw_l)
+        OFF_g = jax.lax.pmax(jnp.max(geom.offset), READS_AXIS)
+        packed = fill_stats_pallas(
+            t, tl, bufs_l, geom, K, T1p, C, off_override=OFF_g,
+            interpret=interpret,
+        )
+        Npad_l = bufs_l.seq_T.shape[1]
+        return packed[:Npad_l], packed[Npad_l:]
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(
+            P(), P(),
+            jax.tree_util.tree_map(lambda _: P(None, READS_AXIS), bufs)._replace(
+                lengths=P(READS_AXIS)
+            ),
+            P(READS_AXIS), P(READS_AXIS),
+        ),
+        out_specs=(P(READS_AXIS), P(READS_AXIS)),
+        check_vma=False,
+    )
+    scores, nerr = fn(template, tlen, bufs, lengths, bandwidths)
+    return jnp.concatenate([scores, nerr])
 
 
 def sharded_consensus_step(

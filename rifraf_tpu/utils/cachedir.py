@@ -1,0 +1,28 @@
+"""Machine-fingerprinted JAX compilation-cache directories.
+
+XLA:CPU AOT cache entries are machine-specific: loading entries compiled
+on a different host (cache dirs survive image snapshots) emits
+cpu_aot_loader machine-mismatch errors and has produced mid-process
+segfaults on this image. Suffixing the dir with a CPU-feature
+fingerprint keeps every machine in its own cache. Shared by the driver
+(engine.driver._enable_compilation_cache) and the test suite
+(tests/conftest.py) so the two schemes cannot drift.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def machine_cache_dir(base: str) -> str:
+    """``base`` suffixed with a fingerprint of the host CPU's feature
+    flags."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            flags = next(
+                (ln for ln in fh if ln.startswith("flags")), "unknown"
+            )
+    except OSError:
+        flags = "unknown"
+    fp = hashlib.md5(flags.encode()).hexdigest()[:10]
+    return f"{base}_{fp}"

@@ -17,12 +17,28 @@ import jax
 # overridden by the harness; the config option always wins)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
 # persistent compilation cache: the engine's bucketed shapes mean a small,
 # stable set of executables — reuse them across test runs. Overridable so
-# concurrent pytest processes can use private caches (the jax cache
-# serializer has segfaulted under concurrent writers on this image).
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("RIFRAF_TPU_CACHE", "/tmp/rifraf_jax_cache"),
+# concurrent pytest processes can use private caches; RIFRAF_TPU_CACHE=off
+# disables it (the jax cache serializer has segfaulted mid-suite on this
+# image — see the machine-fingerprint note above).
+from rifraf_tpu.utils.cachedir import machine_cache_dir  # noqa: E402
+
+_cache = os.environ.get(
+    "RIFRAF_TPU_CACHE", machine_cache_dir("/tmp/rifraf_jax_cache")
 )
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+if _cache and _cache != "off":
+    # one cache dir per xdist worker: the jax cache serializer has
+    # segfaulted under concurrent writers on this image. (The suite
+    # runs under xdist by default — see pytest.ini — both for wall
+    # time and because XLA:CPU's compiler has segfaulted after a few
+    # hundred compilations accumulate in ONE process; splitting the
+    # suite across worker processes keeps every process under the
+    # threshold.)
+    _worker = os.environ.get("PYTEST_XDIST_WORKER")
+    if _worker:
+        _cache = f"{_cache}_{_worker}"
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

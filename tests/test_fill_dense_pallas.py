@@ -49,9 +49,7 @@ def _setup(template, batch):
         jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
         jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
     )
-    lengths = np.asarray(batch.lengths)
-    r_unique = tuple(sorted({int(v) for v in lengths - lengths.min()}))
-    return tlen, geom, K, Tmax, T1p, tpl, Npad, bufs, r_unique
+    return tlen, geom, K, Tmax, T1p, tpl, Npad, bufs
 
 
 def test_backward_halo_blocks_matches_flip_oracle():
@@ -59,7 +57,7 @@ def test_backward_halo_blocks_matches_flip_oracle():
     reproduce flip_reversed_uniform's backward band on every in-band
     cell, for every halo block."""
     template, batch = _problem()
-    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs, r_unique = _setup(
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs = _setup(
         template, batch
     )
     # reversed-problem forward band via the XLA oracle path: backward
@@ -83,7 +81,7 @@ def test_backward_halo_blocks_matches_flip_oracle():
         if T1p % C:
             continue
         Bh = np.asarray(dense_pallas.backward_halo_blocks(
-            Brev_flat, jnp.int32(tlen), OFF, bufs.lengths, r_unique,
+            Brev_flat, jnp.int32(tlen), OFF, bufs.lengths,
             K, T1p, C,
         ))
         n_steps = T1p // C
@@ -114,7 +112,7 @@ def test_fused_step_pallas_matches_xla_dense_interpret():
     from rifraf_tpu.ops.proposal_dense import score_all_edits
 
     template, batch = _problem(tlen=20, n_reads=3, bw=4, seed=7)
-    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs, r_unique = _setup(
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs = _setup(
         template, batch
     )
     # small C: interpret-mode tracing cost scales with the per-step
@@ -122,10 +120,11 @@ def test_fused_step_pallas_matches_xla_dense_interpret():
     C = 8
     weights = np.ones(batch.n_reads, np.float32)
     weights[1] = 0.0  # zero-weight masking
-    packed = np.asarray(dense_pallas.fused_step_pallas(
+    packed, _ = dense_pallas.fused_step_pallas(
         jnp.asarray(tpl), jnp.int32(tlen), bufs, geom,
-        jnp.asarray(weights), K, T1p, C, r_unique, interpret=True,
-    ))
+        jnp.asarray(weights), K, T1p, C, interpret=True,
+    )
+    packed = np.asarray(packed)
     lay = dense_pallas.pack_layout_pallas(Npad, T1p)
     sub_t = packed[slice(*lay["sub"])].reshape(T1p, 4)
     ins_t = packed[slice(*lay["ins"])].reshape(T1p, 4)
@@ -145,3 +144,171 @@ def test_fused_step_pallas_matches_xla_dense_interpret():
         finite = np.isfinite(w)
         np.testing.assert_allclose(g[finite], w[finite], rtol=2e-5, atol=2e-5)
         assert (g[~finite] < -1e30).all()
+
+
+@pytest.mark.slow
+def test_panel_fused_matches_single_launch_interpret():
+    """The panel-blocked long-template path (carry-chained fill panels +
+    per-panel dense slices) must reproduce the single-launch fused step:
+    identical scores, tables, stats."""
+    template, batch = _problem(tlen=40, n_reads=3, bw=4, seed=13)
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs = _setup(
+        template, batch
+    )
+    C = 8
+    weights = np.ones(batch.n_reads, np.float32)
+    one = dense_pallas.fused_tables_pallas(
+        jnp.asarray(tpl), jnp.int32(tlen), bufs, geom,
+        jnp.asarray(weights), K, T1p, C, want_stats=True,
+        interpret=True,
+    )
+    pan = dense_pallas.fused_tables_pallas_panels(
+        jnp.asarray(tpl), jnp.int32(tlen), bufs, geom,
+        jnp.asarray(weights), K, T1p, C,
+        panel_cols=16, want_stats=True, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pan["total"]), np.asarray(one["total"]),
+        rtol=1e-6, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pan["scores"]), np.asarray(one["scores"]),
+        rtol=1e-6, atol=1e-6,
+    )
+    for name in ("sub", "ins", "del"):
+        a, b = np.asarray(pan[name]), np.asarray(one[name])
+        hi = tlen + 1
+        m = b[:hi] > -1e30
+        np.testing.assert_allclose(
+            a[:hi][m], b[:hi][m], rtol=1e-5, atol=1e-5, err_msg=name
+        )
+    np.testing.assert_array_equal(
+        np.asarray(pan["n_errors"]), np.asarray(one["n_errors"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pan["edits"]), np.asarray(one["edits"])
+    )
+
+
+@pytest.mark.slow
+def test_pallas_moves_and_stats_match_xla_interpret():
+    """In-kernel move recording (interpret mode): the uniform-frame move
+    band must equal the XLA scan's per-read-frame moves row-for-row
+    (shifted by each read's frame delta), and the traceback statistics
+    built from it (n_errors + union edit indicators) must match the XLA
+    want_stats components exactly."""
+    template, batch = _problem(tlen=16, n_reads=3, bw=4, seed=11)
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs = _setup(
+        template, batch
+    )
+    C = 8
+    A_u, _, sc_u, OFF, moves_u = fill_pallas.fill_uniform(
+        jnp.asarray(tpl), jnp.int32(tlen), bufs, geom, K, T1p, C,
+        with_backward=False, want_moves=True, interpret=True,
+    )
+    moves_u = np.asarray(moves_u)
+
+    Kx = align_jax.band_height(batch, tlen)
+    _, moves_x, scores_x, _ = align_jax.forward_batch(
+        tpl, batch, tlen=tlen, K=Kx, want_moves=True
+    )
+    moves_x = np.asarray(moves_x)
+    np.testing.assert_allclose(
+        np.asarray(sc_u)[: batch.n_reads], np.asarray(scores_x),
+        rtol=1e-5, atol=1e-5,
+    )
+    off = np.asarray(geom.offset)
+    delta = int(OFF) - off
+    T1 = tlen + 1
+    Ax = np.asarray(
+        align_jax.forward_batch(tpl, batch, tlen=tlen, K=Kx)[0]
+    )
+    slen = np.asarray(geom.slen)
+    for k in range(batch.n_reads):
+        dk = int(delta[k])
+        # uniform row d holds per-read row d - delta_k; rows past the
+        # uniform buffer exist only when another read's frame is taller,
+        # and are all TRACE_NONE in the per-read band
+        hi = min(dk + Kx, moves_u.shape[1])
+        got = moves_u[k, dk:hi, :T1]
+        want = moves_x[k, : hi - dk, :T1]
+        assert (moves_x[k, hi - dk :, :T1] == 0).all()
+        # the two engines order the insert-chain G-sums differently, so
+        # candidates that tie exactly in one engine differ by an ulp in
+        # the other — move equality is only required at cells whose
+        # top-two candidates are separated; ambiguous cells must still
+        # record a move consistent with the cell value
+        sq, mt = np.asarray(batch.seq)[k], np.asarray(batch.match)[k]
+        mm, gi = np.asarray(batch.mismatch)[k], np.asarray(batch.ins)[k]
+        dl = np.asarray(batch.dels)[k]
+        n_ambiguous = 0
+        for d in range(hi - dk):
+            for j in range(T1):
+                if got[d, j] == want[d, j]:
+                    continue
+                i = d + j - int(off[k])
+                cands = [-np.inf, -np.inf, -np.inf]
+                if j > 0 and 1 <= i <= slen[k]:
+                    msc = mt[i - 1] if sq[i - 1] == tpl[j - 1] else mm[i - 1]
+                    cands[0] = Ax[k, d, j - 1] + msc
+                if j > 0 and d + 1 < Kx and i <= slen[k]:
+                    cands[1] = Ax[k, d + 1, j - 1] + dl[i]
+                if d > 0 and 1 <= i <= slen[k]:
+                    cands[2] = Ax[k, d - 1, j] + gi[i - 1]
+                top2 = sorted(cands)[-2:]
+                assert top2[1] - top2[0] < 1e-4, (
+                    f"read {k} d={d} j={j}: moves differ at an "
+                    f"unambiguous cell ({got[d, j]} vs {want[d, j]}, "
+                    f"cands {cands})"
+                )
+                n_ambiguous += 1
+        assert n_ambiguous <= 8, "too many tie cells to trust the oracle"
+
+    # stats from the Pallas move band == the XLA want_stats components
+    nerr_u, edits_u = dense_pallas.stats_from_moves(
+        jnp.asarray(moves_u[:, :, :Tmax + 1]), bufs.seq_T.T,
+        jnp.asarray(tpl), geom, bufs.lengths, K,
+    )
+    stats = jax.vmap(
+        align_jax._traceback_stats_one, in_axes=(0, 0, None, 0, None)
+    )
+    nerr_x, edits_x = stats(
+        jnp.asarray(moves_x), jnp.asarray(batch.seq), jnp.asarray(tpl),
+        geom, Kx,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(nerr_u)[: batch.n_reads], np.asarray(nerr_x)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(edits_u), np.asarray(jnp.max(edits_x, axis=0))
+    )
+
+
+@pytest.mark.slow
+def test_fill_stats_pallas_packed_interpret():
+    """fill_stats_pallas (the adaptation-round program) returns the same
+    scores and error counts as the full-fat paths."""
+    template, batch = _problem(tlen=16, n_reads=2, bw=4, seed=5)
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs = _setup(
+        template, batch
+    )
+    packed = np.asarray(dense_pallas.fill_stats_pallas(
+        jnp.asarray(tpl), jnp.int32(tlen), bufs, geom, K, T1p, 8,
+        interpret=True,
+    ))
+    scores_p = packed[:Npad][: batch.n_reads]
+    nerr_p = packed[Npad : 2 * Npad][: batch.n_reads].astype(np.int64)
+
+    Kx = align_jax.band_height(batch, tlen)
+    _, moves_x, scores_x, _ = align_jax.forward_batch(
+        tpl, batch, tlen=tlen, K=Kx, want_moves=True
+    )
+    stats = jax.vmap(
+        align_jax._traceback_stats_one, in_axes=(0, 0, None, 0, None)
+    )
+    nerr_x, _ = stats(
+        moves_x, jnp.asarray(batch.seq), jnp.asarray(tpl), geom, Kx
+    )
+    np.testing.assert_allclose(scores_p, np.asarray(scores_x),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(nerr_p, np.asarray(nerr_x))

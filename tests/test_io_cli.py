@@ -118,6 +118,38 @@ def test_consensus_cli_recovers_templates(tmp_path):
         assert decode_seq(seq) == want, f"cluster {k} consensus != template"
 
 
+def test_consensus_cli_sharded_sweep(tmp_path):
+    """--sharded-sweep (one device program for all clusters) recovers
+    each cluster's template and rejects reference runs."""
+    from rifraf_tpu.models.errormodel import ErrorModel
+
+    rng = np.random.default_rng(11)
+    templates = []
+    for k in range(2):
+        _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+            nseqs=4, length=40, error_rate=0.02, rng=rng,
+            seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+        )
+        write_fastq(str(tmp_path / f"cluster-{k}.fastq"), seqs, phreds)
+        templates.append(template)
+    out = str(tmp_path / "out.fasta")
+    rc = consensus_main([
+        "1,2,2", str(tmp_path / "cluster-*.fastq"), out, "--sharded-sweep",
+    ])
+    assert rc == 0
+    got = read_fasta(out)
+    assert len(got) == 2
+    for seq, template in zip(got, templates):
+        np.testing.assert_array_equal(seq, template)
+
+    with pytest.raises(ValueError, match="sharded-sweep"):
+        consensus_main([
+            "--reference", os.path.join(DATA, "references.fasta"),
+            "1,2,2", str(tmp_path / "cluster-*.fastq"), out,
+            "--sharded-sweep",
+        ])
+
+
 def test_shifts_cli(tmp_path):
     infile = str(tmp_path / "in.fasta")
     outfile = str(tmp_path / "out.fasta")

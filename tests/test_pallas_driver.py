@@ -1,0 +1,174 @@
+"""Production-path equality for the Pallas realign engine (interpret).
+
+RIFRAF_TPU_PALLAS_INTERPRET=1 makes BatchAligner.pallas_eligible accept
+the CPU backend and runs every Pallas kernel in interpret mode, so the
+exact production wiring — packed-fetch layout, stats realigns with
+in-kernel move recording, SCORE-stage move fetches + host traceback,
+adaptation rounds on fill_stats_pallas, and the shard_map mesh variant —
+is exercised through BatchAligner.realign and compared against the XLA
+engine on identical problems. (Whole-driver interpret runs cost minutes
+per hill-climb; the driver logic above the aligner is backend-agnostic
+and pinned by the XLA-vs-numpy oracle suites.)
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from rifraf_tpu.engine.realign import BatchAligner
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import make_read_scores
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+
+
+def _reads(n=4, tlen=24, seed=3, bw=5, fixed=True):
+    rng = np.random.default_rng(seed)
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n):
+        slen = int(rng.integers(tlen - 5, tlen + 6))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        reads.append(
+            make_read_scores(s, rng.uniform(-3.0, -1.0, size=slen), bw, SCORES)
+        )
+    for r in reads:
+        r.bandwidth_fixed = fixed
+    return template, reads
+
+
+def _assert_aligners_agree(al_p, al_x, stats: bool, tlen: int):
+    assert al_p._total == pytest.approx(al_x._total, rel=1e-5, abs=1e-4)
+    # the mesh aligner keeps its mesh-padding duplicate reads' scores;
+    # compare the real-read prefix
+    n = min(len(al_p.reads), len(al_x.reads))
+    np.testing.assert_allclose(
+        np.asarray(al_p.scores)[:n], np.asarray(al_x.scores)[:n],
+        rtol=1e-5, atol=1e-4,
+    )
+    # valid rows: sub/del cover positions [0, tlen), ins [0, tlen]
+    for a, b, hi, name in zip(
+        al_p._tables_host, al_x._tables_host,
+        (tlen, tlen + 1, tlen), ("sub", "ins", "del"),
+    ):
+        a, b = np.asarray(a)[:hi], np.asarray(b)[:hi]
+        m = np.isfinite(b) & (b > -1e30)
+        np.testing.assert_allclose(
+            a[m], b[m], rtol=2e-4, atol=2e-4, err_msg=name
+        )
+        assert (a[~m] < -1e28).all(), name
+    if stats:
+        np.testing.assert_array_equal(al_p.edits_seen, al_x.edits_seen)
+
+
+@pytest.mark.slow
+def test_realign_stats_pallas_matches_xla(monkeypatch):
+    """want_stats realign (the reference-default candidate machinery):
+    in-kernel moves + device stats == the XLA stats components."""
+    monkeypatch.setenv("RIFRAF_TPU_PALLAS_INTERPRET", "1")
+    template, reads = _reads()
+    al_p = BatchAligner(reads, dtype=np.float32)
+    al_p.realign(template, 0.1, want_stats=True)
+    al_x = BatchAligner(reads, dtype=np.float32, backend="xla")
+    al_x.realign(template, 0.1, want_stats=True)
+    _assert_aligners_agree(al_p, al_x, stats=True, tlen=len(template))
+
+
+def _path_score(moves, read, template):
+    """Score of a traceback path under the read's score vectors — the DP
+    objective itself (align.jl:50-112, no trim/skew)."""
+    i = j = total = 0
+    for m in moves:
+        if m == 1:  # match
+            i += 1
+            j += 1
+            total += (
+                read.match_scores[i - 1]
+                if read.seq[i - 1] == template[j - 1]
+                else read.mismatch_scores[i - 1]
+            )
+        elif m == 2:  # insert
+            i += 1
+            total += read.ins_scores[i - 1]
+        else:  # delete
+            j += 1
+            total += read.del_scores[i]
+    assert i == len(read) and j == len(template)
+    return total
+
+
+@pytest.mark.slow
+def test_realign_moves_pallas_matches_xla(monkeypatch):
+    """want_moves realign (SCORE stage): the uniform-frame move fetch +
+    host traceback walk yields complete optimal paths. The two engines
+    order the insert-chain G-sums differently, so exact-tie cells can
+    legitimately break toward different (equally optimal) moves — each
+    path must reproduce ITS OWN engine's score, and the scores must
+    agree."""
+    monkeypatch.setenv("RIFRAF_TPU_PALLAS_INTERPRET", "1")
+    template, reads = _reads(seed=9)
+    al_p = BatchAligner(reads, dtype=np.float32)
+    al_p.realign(template, 0.1, want_moves=True)
+    al_x = BatchAligner(reads, dtype=np.float32, backend="xla")
+    al_x.realign(template, 0.1, want_moves=True)
+    _assert_aligners_agree(al_p, al_x, stats=False, tlen=len(template))
+    assert len(al_p.tracebacks) == len(reads)
+    for k, read in enumerate(reads):
+        sp = _path_score(al_p.tracebacks[k], read, template)
+        sx = _path_score(al_x.tracebacks[k], read, template)
+        assert sp == pytest.approx(float(al_p.scores[k]), abs=1e-3)
+        assert sx == pytest.approx(float(np.asarray(al_x.scores)[k]), abs=1e-3)
+
+
+@pytest.mark.slow
+def test_realign_adaptation_pallas_matches_xla(monkeypatch):
+    """Unsettled bandwidths: the fill_stats_pallas adaptation rounds
+    must settle to the same per-read bandwidths as the XLA rounds."""
+    monkeypatch.setenv("RIFRAF_TPU_PALLAS_INTERPRET", "1")
+    # low starting bandwidth + long reads forces at least one doubling
+    template, reads = _reads(n=3, tlen=32, seed=5, bw=2, fixed=False)
+    al_p = BatchAligner(reads, dtype=np.float32)
+    al_p.realign(template, 0.1)
+    template2, reads2 = _reads(n=3, tlen=32, seed=5, bw=2, fixed=False)
+    al_x = BatchAligner(reads2, dtype=np.float32, backend="xla")
+    al_x.realign(template2, 0.1)
+    np.testing.assert_array_equal(al_p.bandwidths, al_x.bandwidths)
+    np.testing.assert_array_equal(al_p.fixed, al_x.fixed)
+    _assert_aligners_agree(al_p, al_x, stats=False, tlen=len(template))
+
+
+@pytest.mark.slow
+def test_realign_mesh_pallas_matches_single(monkeypatch):
+    """The shard_map mesh variant (8 virtual devices) must agree with
+    the single-device XLA aligner — the multi-chip north-star realign
+    on the fast engine."""
+    from rifraf_tpu.parallel.sharding import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    monkeypatch.setenv("RIFRAF_TPU_PALLAS_INTERPRET", "1")
+    template, reads = _reads(n=6, tlen=24, seed=7)
+    mesh = make_mesh(8)
+    al_p = BatchAligner(reads, dtype=np.float32, mesh=mesh)
+    assert al_p.pallas_eligible(len(template))
+    al_p.realign(template, 0.1, want_stats=True)
+    al_x = BatchAligner(reads, dtype=np.float32, backend="xla")
+    al_x.realign(template, 0.1, want_stats=True)
+    # scores/tables vs the XLA engine (fp tolerance; exact-tie cells can
+    # break toward different equally-optimal paths across engines, so
+    # the discrete edit indicators are compared against the SINGLE-
+    # DEVICE Pallas engine instead — identical per-lane arithmetic)
+    _assert_aligners_agree(al_p, al_x, stats=False, tlen=len(template))
+    al_s = BatchAligner(reads, dtype=np.float32)
+    al_s.realign(template, 0.1, want_stats=True)
+    np.testing.assert_array_equal(al_p.edits_seen, al_s.edits_seen)
+
+
+def test_backend_pallas_unavailable_off_tpu(monkeypatch):
+    """An explicit backend='pallas' must fail loudly off-TPU (without
+    the interpret test hook) — never silently fall back to XLA."""
+    monkeypatch.delenv("RIFRAF_TPU_PALLAS_INTERPRET", raising=False)
+    template, reads = _reads(n=2, tlen=16)
+    with pytest.raises(ValueError, match="pallas"):
+        BatchAligner(reads, dtype=np.float32, backend="pallas")

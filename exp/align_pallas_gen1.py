@@ -33,8 +33,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..models.sequences import ReadBatch
-from .align_jax import BandGeometry, batch_geometry
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from rifraf_tpu.models.sequences import ReadBatch
+from rifraf_tpu.ops.align_jax import BandGeometry, batch_geometry
 
 NEG_INF = float(np.finfo(np.float32).min) / 2  # avoid inf arithmetic on VPU
 
@@ -275,7 +279,7 @@ def forward_batch_pallas(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, BandGeometry]:
     """Pallas banded forward fill. Returns (bands [N, K, T+1], scores [N],
     geometry), matching align_jax.forward_batch's band layout."""
-    from .align_jax import band_height
+    from rifraf_tpu.ops.align_jax import band_height
 
     if tlen is None:
         tlen = len(template)
@@ -364,7 +368,7 @@ def backward_batch_pallas(
     must be a positive multiple of 8 (the kernel's sublane tile): silently
     rounding here would desynchronize the band height from an
     align_jax.backward_batch call made with the same K."""
-    from .align_jax import band_height
+    from rifraf_tpu.ops.align_jax import band_height
 
     if tlen is None:
         tlen = len(template)

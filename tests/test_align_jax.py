@@ -93,6 +93,7 @@ def test_check_all_cols_invariant():
             np.testing.assert_allclose(col_max, total, rtol=1e-9, err_msg=f"col {j}")
 
 
+@pytest.mark.slow
 def test_batched_mixed_lengths():
     """Reads of different lengths / bandwidths in one padded batch."""
     rng = np.random.default_rng(7)
@@ -113,6 +114,7 @@ def test_batched_mixed_lengths():
         assert_band_equal(bands[k], oracle, len(rs), tlen, rs.bandwidth)
 
 
+@pytest.mark.slow
 def test_template_bucket_padding():
     """Padded template columns must not affect scores (dynamic tlen)."""
     rng = np.random.default_rng(11)
@@ -150,6 +152,7 @@ def path_score(moves, t, rs):
     return total
 
 
+@pytest.mark.slow
 def test_traceback_matches_oracle():
     rng = np.random.default_rng(3)
     tlen = 22
@@ -178,6 +181,7 @@ def test_traceback_matches_oracle():
         assert (at[at >= 0] == t).all()
 
 
+@pytest.mark.slow
 def test_traceback_stats_match_host_walk():
     """The device scan-based traceback statistics (error counts + edit
     indicator table) must equal the host pointer-chase walk on the same
@@ -236,6 +240,7 @@ def test_traceback_stats_match_host_walk():
             assert (edits[k].astype(bool) == want).all(), (trial, k)
 
 
+@pytest.mark.slow
 def test_trim_and_skew_match_oracle():
     rng = np.random.default_rng(19)
     t, rs = random_case(rng, 20, 14, 5)

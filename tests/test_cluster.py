@@ -3,6 +3,7 @@ CLI fan-out path (the reference's pmap over files, scripts/rifraf.jl:190-191).
 """
 
 import numpy as np
+import pytest
 
 from rifraf_tpu.cli.consensus import main as consensus_main
 from rifraf_tpu.engine.driver import rifraf
@@ -28,6 +29,7 @@ def _make_cluster(seed, length=60, nseqs=6):
     return template, seqs, phreds
 
 
+@pytest.mark.slow
 def test_sweep_matches_sequential():
     """Concurrent workers produce bit-identical results to a plain loop,
     in job order, regardless of completion order."""
@@ -45,6 +47,7 @@ def test_sweep_matches_sequential():
         assert seq_r.state.converged == par_r.state.converged
 
 
+@pytest.mark.slow
 def test_sweep_recovers_templates():
     clusters = [_make_cluster(seed, length=50) for seed in (10, 11, 13)]
 
@@ -57,6 +60,7 @@ def test_sweep_recovers_templates():
         assert decode_seq(r.consensus) == decode_seq(template)
 
 
+@pytest.mark.slow
 def test_sweep_empty_and_single():
     assert sweep_clusters(lambda x: x + 1, []) == []
     assert sweep_clusters(lambda x: x + 1, [41]) == [42]
@@ -72,6 +76,7 @@ def test_resolve_jobs_flag():
     assert resolve_jobs_flag(7, 2) == 2
 
 
+@pytest.mark.slow
 def test_cli_jobs_matches_sequential(tmp_path):
     """The CLI sweep with --jobs N writes the same FASTA as --jobs 1."""
     for k in range(3):
@@ -91,6 +96,7 @@ def test_cli_jobs_matches_sequential(tmp_path):
     assert len(got_seq) == 3
 
 
+@pytest.mark.slow
 def test_sweep_propagates_job_failure():
     """A failing job fails the whole sweep (the reference re-throws
     RemoteException from workers, scripts/rifraf.jl:204-207)."""

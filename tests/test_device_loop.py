@@ -72,6 +72,7 @@ def test_decode_matches_generation_order():
         assert anchor[idx] == host_anchor(p), idx
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 7, 19])
 def test_apply_matches_host_apply_proposals(seed):
     """_apply == apply_proposals for random min-dist-separated sets."""
@@ -166,6 +167,7 @@ def test_device_loop_matches_host_loop(seed, err, use_ref):
             assert np.array_equal(x, y)
 
 
+@pytest.mark.slow
 def test_device_loop_respects_max_iters():
     """iters_left must bound the device stage exactly like max_iters
     bounds the host loop."""

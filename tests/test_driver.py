@@ -96,6 +96,7 @@ _GRID = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "idx,use_ref,do_alignment_proposals,seed_indels,indel_correction_only,batch_size",
     _GRID,
@@ -144,6 +145,7 @@ def test_frame_correction_fixes_frameshift():
     assert not has_single_indels(result.consensus, result.state.reference)
 
 
+@pytest.mark.slow
 def test_do_score_quality_estimation():
     """Quality estimation output shapes and ranges (test_model.jl:378-449)."""
     rng = np.random.default_rng(11)
@@ -217,6 +219,7 @@ def _noisy_reads(n=6, length=120, seed=11, error_rate=0.02):
     return template, reads
 
 
+@pytest.mark.slow
 def test_bandwidth_cap_uses_entry_bandwidth():
     """Regression: max_bw must be computed once from the entry bandwidth
     (model.jl:650 caps at bandwidth*2^5), not recomputed from the
@@ -242,6 +245,7 @@ def test_bandwidth_cap_uses_entry_bandwidth():
     assert (aligner.bandwidths <= cap).all(), aligner.bandwidths
 
 
+@pytest.mark.slow
 def test_bandwidth_growth_never_outruns_final_refill():
     """After realign() the A and B bands must always have identical band
     heights, even when bandwidth adaptation maxes out its doublings."""
@@ -257,6 +261,7 @@ def test_bandwidth_growth_never_outruns_final_refill():
     assert aligner.fixed.all()
 
 
+@pytest.mark.slow
 def test_same_membership_resample_keeps_batch_state():
     """resample() rebuilds the batch list object each iteration, so the
     aligner must compare batch MEMBERSHIP, not list identity: an unchanged
@@ -299,6 +304,7 @@ def test_batch_threshold_validated():
         check_params(params.scores, 0, params)
 
 
+@pytest.mark.slow
 def test_use_ref_for_qvs_without_frame_builds_reference():
     """Regression: with do_frame=False + use_ref_for_qvs=True the SCORE
     stage must never score against the placeholder reference built by
@@ -325,6 +331,7 @@ def test_use_ref_for_qvs_without_frame_builds_reference():
     assert np.all((probs >= 0.0) & (probs <= 1.0))
 
 
+@pytest.mark.slow
 def test_verbose3_dumps_consensus_and_timers(capsys):
     """verbose>=3 prints the full per-iteration consensus (model.jl:1164-
     1168); verbose>=2 prints the length line and the timer summary."""

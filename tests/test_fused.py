@@ -5,6 +5,9 @@ a chunk size that does NOT divide the read count — the padding path)."""
 import numpy as np
 import pytest
 
+# every test compiles the big fused XLA step (x64 CPU compile dominates on 1-core hosts)
+pytestmark = pytest.mark.slow
+
 from rifraf_tpu.models.errormodel import ErrorModel, Scores
 from rifraf_tpu.models.sequences import batch_reads, make_read_scores
 from rifraf_tpu.ops import align_jax

@@ -96,6 +96,7 @@ def test_simulator_mixture_sizes():
     assert len(ref) % 3 == 0
 
 
+@pytest.mark.slow
 def test_consensus_cli_recovers_templates(tmp_path):
     """End-to-end golden run on the shipped example data."""
     out = str(tmp_path / "consensus.fasta")
@@ -118,6 +119,7 @@ def test_consensus_cli_recovers_templates(tmp_path):
         assert decode_seq(seq) == want, f"cluster {k} consensus != template"
 
 
+@pytest.mark.slow
 def test_consensus_cli_sharded_sweep(tmp_path):
     """--sharded-sweep (one device program for all clusters) recovers
     each cluster's template and rejects reference runs."""

@@ -40,6 +40,7 @@ def test_eight_virtual_devices_present():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.slow
 def test_sharded_step_matches_single_device():
     template, batch = _problem(n_reads=8)
     tlen = len(template)
@@ -92,6 +93,7 @@ def test_padded_batch_weights_mask_dummies():
     np.testing.assert_allclose(float(total), float(np.sum(scores)), rtol=1e-12)
 
 
+@pytest.mark.slow
 def test_graft_entry_single_chip():
     import sys, os
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -140,6 +142,7 @@ def test_weighted_read_sum_masks_padding_not_neg_inf():
     assert total == -12.0
 
 
+@pytest.mark.slow
 def test_sharded_rifraf_matches_single_device():
     """The integrated mesh path: rifraf() with params.mesh sharding the
     read axis over the 8-device virtual mesh must return the identical
@@ -164,6 +167,7 @@ def test_sharded_rifraf_matches_single_device():
     assert np.isclose(base.state.score, sharded.state.score)
 
 
+@pytest.mark.slow
 def test_sharded_rifraf_uneven_reads():
     """Read count not divisible by the mesh: padding via duplicated
     weight-0 reads must not change the answer."""

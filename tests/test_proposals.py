@@ -192,6 +192,7 @@ def test_rescoring_property_edges(kind, make_proposal):
                             proposals_per_template=1)
 
 
+@pytest.mark.slow
 def test_rescoring_trick_equals_full_realignment_jax():
     """Same property for the batched device scorer (no codon moves)."""
     rng = np.random.default_rng(99)
@@ -227,6 +228,7 @@ def test_rescoring_trick_equals_full_realignment_jax():
             )
 
 
+@pytest.mark.slow
 def test_jax_scorer_matches_np_scorer():
     """JAX batch scorer vs numpy oracle on every proposal."""
     rng = np.random.default_rng(5)

@@ -4,6 +4,9 @@
 import numpy as np
 import pytest
 
+# whole-sweep executables are the most expensive compiles in the tree (x64 CPU compile dominates on 1-core hosts)
+pytestmark = pytest.mark.slow
+
 jax = pytest.importorskip("jax")
 
 from rifraf_tpu.engine.driver import rifraf

@@ -33,9 +33,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+import os
 import sys
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from rifraf_tpu.models.sequences import ReadBatch
 from rifraf_tpu.ops.align_jax import BandGeometry, batch_geometry

@@ -58,18 +58,23 @@ class StageResult(NamedTuple):
 
 
 def _candidate_scores(sub_t, ins_t, del_t, tmpl, tlen, total, do_indels,
-                      Tmax: int):
+                      Tmax: int, do_subs: bool = True):
     """Flat candidate score vector in all_proposals' emission order:
     [Ins(0, b) x4] then per position j: [Sub(j, b) x4, Del(j),
     Ins(j+1, b) x4]. Ineligible slots (own-base substitutions, positions
-    beyond tlen, indels when disabled, non-improving) hold NEG."""
+    beyond tlen, subs/indels when disabled, non-improving) hold NEG.
+    ``do_subs=False`` is FRAME's indel_correction_only gating
+    (model.jl:423-426)."""
     j = jnp.arange(Tmax)
     live = j < tlen
-    sub = jnp.where(
-        live[:, None] & (jnp.arange(4)[None, :] != tmpl[:Tmax, None]),
-        sub_t[:Tmax],
-        NEG,
-    )
+    if do_subs:
+        sub = jnp.where(
+            live[:, None] & (jnp.arange(4)[None, :] != tmpl[:Tmax, None]),
+            sub_t[:Tmax],
+            NEG,
+        )
+    else:
+        sub = jnp.full((Tmax, 4), NEG)
     if do_indels:
         dele = jnp.where(live, del_t[:Tmax], NEG)
         ins0 = ins_t[0]
@@ -180,6 +185,7 @@ def make_stage_runner(
     H: int,  # history capacity = params.max_iters + 1 (static)
     Tmax: int,
     stop_on_same: bool,
+    do_subs: bool = True,
 ):
     """Build the jitted whole-stage runner. ``step_fn`` takes the
     device-resident batch state as an ARGUMENT pytree (not a closure) so
@@ -216,7 +222,8 @@ def make_stage_runner(
             stop_same = jnp.asarray(False)
 
         cand = _candidate_scores(
-            sub_t, ins_t, del_t, tmpl, tlen, total, do_indels, Tmax
+            sub_t, ins_t, del_t, tmpl, tlen, total, do_indels, Tmax,
+            do_subs,
         )
         kind, pos, base, keep, n_improving, best = _choose(cand, min_dist)
         no_cand = n_improving == 0

@@ -487,9 +487,25 @@ def _try_device_stage(
 
         if jax.default_backend() != "tpu":
             return None
-    if state.stage not in (Stage.INIT, Stage.REFINE):
+    if state.stage in (Stage.INIT, Stage.REFINE):
+        # the dense tables score ALL edits; the traceback-restricted
+        # candidate set of do_alignment_proposals is a different
+        # algorithm
+        if params.do_alignment_proposals:
+            return None
+    elif state.stage == Stage.FRAME:
+        # FRAME always uses all_proposals (alignment proposals are an
+        # INIT/REFINE-only mechanism), but indel SEEDING restricts the
+        # candidate set from the consensus-vs-reference alignment
+        # (model.jl:538-562) — a different algorithm the loop does not
+        # implement
+        if params.seed_indels:
+            return None
+        if state.reference is None or not state.ref_built:
+            return None
+    else:
         return None
-    if params.do_alignment_proposals or params.min_dist < 2:
+    if params.min_dist < 2:
         return None
     if params.verbose >= 2:
         return None
@@ -507,13 +523,26 @@ def _try_device_stage(
     resample(state, params, rng)
     if not _same_batch(state.aligner, state.batch_seqs):
         return None
-    runner = state.aligner.stage_runner(
-        len(state.consensus),
-        do_indels=state.stage == Stage.INIT,
-        min_dist=params.min_dist,
-        history_cap=params.max_iters + 1,
-        stop_on_same=full_batch,
-    )
+    if state.stage == Stage.FRAME:
+        runner = state.aligner.stage_runner_frame(
+            len(state.consensus),
+            state.reference,
+            indel_correction_only=params.indel_correction_only,
+            min_dist=params.min_dist,
+            history_cap=params.max_iters + 1,
+            # after a penalty escalation the host's check_score skips
+            # its stall test once (penalties_increased); the loop's
+            # stop-on-same must not fire in its place
+            stop_on_same=full_batch and not state.penalties_increased,
+        )
+    else:
+        runner = state.aligner.stage_runner(
+            len(state.consensus),
+            do_indels=state.stage == Stage.INIT,
+            min_dist=params.min_dist,
+            history_cap=params.max_iters + 1,
+            stop_on_same=full_batch,
+        )
     if runner is None:
         return None
     stage_idx = int(state.stage) - 1

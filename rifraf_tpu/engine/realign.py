@@ -580,6 +580,93 @@ class BatchAligner:
         self._stage_runners[key] = runner
         return runner
 
+    def stage_runner_frame(self, tlen0: int, ref: ReadScores,
+                           indel_correction_only: bool, min_dist: int,
+                           history_cap: int, stop_on_same: bool):
+        """Jitted whole-FRAME-stage runner: the read step plus the codon
+        reference engine's dense all-edit tables, so penalty-escalation
+        rounds of FRAME (model.jl:1150-1227 with reference scoring) run
+        as one dispatch each. Same caching/bail contract as
+        stage_runner; None when no engine fits (mesh, unsettled
+        bandwidths, or the reference's bandwidth not yet adapted)."""
+        import jax.numpy as jnp
+
+        from ..ops.align_codon_jax import (
+            band_height_codon,
+            get_engine,
+        )
+        from .device_loop import MAX_DRIFT
+
+        if (not bool(self.fixed.all()) or self.mesh is not None
+                or not ref.bandwidth_fixed):
+            return None
+        Tmax = _bucket(tlen0 + 1, self.len_bucket)
+        mode = self._pallas_mode(tlen0)
+        if mode == "panels":
+            return None
+        use_pallas = mode == "single"
+        K = (self._pallas_K(tlen0, margin=MAX_DRIFT) if use_pallas
+             else _bucket(self._K(tlen0) + MAX_DRIFT, 8))
+        eng = get_engine(ref)
+        rt = eng._tables(ref.bandwidth, False)
+        Kc = _bucket(
+            band_height_codon(len(ref), tlen0, ref.bandwidth)
+            + MAX_DRIFT + 1, 16,
+        )
+        T1pc = Tmax + 64
+        nrows = eng.Lpad + 1
+        do_subs = not indel_correction_only
+        # the hit must hold the SAME RefTables object: penalty
+        # escalation rebuilds rt, and an id()-style key could collide
+        # after GC and serve a runner closed over stale penalty tables
+        # (the same hazard align_codon_jax._ENGINE_CACHE guards)
+        key = ("frame", Tmax, K, use_pallas, do_subs, min_dist,
+               history_cap, stop_on_same, Kc, T1pc, nrows, ref.bandwidth)
+        hit = self._stage_runners.get(key)
+        if hit is not None and hit[0] is rt:
+            return hit[1]
+
+        n_reads = self.batch.n_reads
+        T1 = Tmax + 1
+        T1p = _bucket(T1, 64)
+        bw_dev = jnp.asarray(self.bandwidths)
+        lengths_dev = jnp.asarray(self._lengths_host)
+        rt9 = tuple(rt[:9])
+
+        if use_pallas:
+            from ..ops.dense_pallas import pick_dense_cols
+
+            C = 8 if _pallas_interpret() else pick_dense_cols(T1p, K)
+            weights = jnp.ones(n_reads, dtype=jnp.float32)
+            base = _pallas_frame_runner(
+                K, T1p, C, True, do_subs, min_dist, history_cap, Tmax,
+                stop_on_same, Kc, T1pc, nrows, rt.do_cins, rt.do_cdel,
+            )
+            read_state = (self._ensure_fill_bufs(), lengths_dev, bw_dev,
+                          weights)
+        else:
+            batch = self._current_batch()
+            chunk = _pick_read_chunk(n_reads, K, T1, self.hbm_budget)
+            weights = jnp.ones(n_reads, dtype=self.dtype)
+            base = _xla_frame_runner(
+                K, T1, Tmax, chunk, n_reads, True, do_subs, min_dist,
+                history_cap, stop_on_same, Kc, T1pc, nrows,
+                rt.do_cins, rt.do_cdel,
+            )
+            read_state = (
+                (batch.seq, batch.match, batch.mismatch, batch.ins,
+                 batch.dels),
+                lengths_dev, bw_dev, weights,
+            )
+        state = (read_state, rt9)
+
+        def runner(consensus, prev_score, iters_left, prev_iters=0):
+            return base(consensus, prev_score, iters_left, prev_iters,
+                        step_state=state)
+
+        self._stage_runners[key] = (rt, runner)
+        return runner
+
     # --- alignment --------------------------------------------------------
     def realign(
         self,
@@ -897,6 +984,155 @@ class BatchAligner:
         for k, r in enumerate(self.reads):
             r.bandwidth = int(self.bandwidths[k])
             r.bandwidth_fixed = bool(self.fixed[k])
+
+
+def _frame_ref_tables(Tmax: int, Kc: int, T1pc: int, nrows: int,
+                      do_cins: bool, do_cdel: bool):
+    """Dense all-edit score tables of consensus-vs-REFERENCE with codon
+    moves, as a jit-friendly function (tmpl, tlen, rt_arrays) ->
+    (ref_score, sub [Tmax, 4], ins [Tmax + 1, 4], del [Tmax]). One
+    codon-engine fill pair + one vmapped O(band) rescoring over every
+    single-base edit (model.jl:302-383 densified, as ops.proposal_dense
+    does for reads). Positions >= tlen hold garbage; the device loop
+    masks them."""
+    import jax.numpy as jnp
+
+    from ..ops.align_codon_jax import (
+        KIND_DEL,
+        KIND_INS,
+        KIND_SUB,
+        RefTables,
+        _score_proposals_codon,
+        backward_codon,
+        forward_codon,
+    )
+
+    n_sub, n_del, n_ins = Tmax * 4, Tmax, (Tmax + 1) * 4
+    kinds = np.concatenate([
+        np.full(n_sub, KIND_SUB), np.full(n_del, KIND_DEL),
+        np.full(n_ins, KIND_INS),
+    ]).astype(np.int32)
+    poss = np.concatenate([
+        np.repeat(np.arange(Tmax), 4), np.arange(Tmax),
+        np.repeat(np.arange(Tmax + 1), 4),
+    ]).astype(np.int32)
+    bases = np.concatenate([
+        np.tile(np.arange(4), Tmax), np.zeros(Tmax),
+        np.tile(np.arange(4), Tmax + 1),
+    ]).astype(np.int32)
+    kinds_d, poss_d, bases_d = (
+        jnp.asarray(kinds), jnp.asarray(poss), jnp.asarray(bases)
+    )
+
+    def ref_tables(tmpl, tlen, rt9):
+        # rt9: the 9 RefTables arrays (the bool flags ride as statics so
+        # the step_state pytree stays all-array)
+        rt = RefTables(*rt9, do_cins=do_cins, do_cdel=do_cdel)
+        fwd = forward_codon(tmpl[:Tmax], tlen, rt, Kc, T1pc)
+        bwd = backward_codon(tmpl[:Tmax], tlen, rt, Kc, T1pc)
+        t_cols = jnp.pad(
+            jnp.concatenate([tmpl[:1], tmpl[:Tmax]]).astype(jnp.int8),
+            (0, T1pc - Tmax - 1),
+        )
+        flat = _score_proposals_codon(
+            kinds_d, poss_d, bases_d, t_cols, tlen,
+            fwd.bands, fwd.starts, bwd.bands, bwd.starts,
+            tuple(rt[:9]), Kc, T1pc, nrows, do_cins, do_cdel,
+        )
+        sub_t = flat[:n_sub].reshape(Tmax, 4)
+        del_t = flat[n_sub : n_sub + n_del]
+        ins_t = flat[n_sub + n_del :].reshape(Tmax + 1, 4)
+        return fwd.score, sub_t, ins_t, del_t
+
+    return ref_tables
+
+
+def _add_ref_tables(read_out, ref_out, Tmax: int):
+    """Sum the read-batch tables and the reference tables (the
+    per-candidate score is reads + reference, model.jl:385-399). The
+    read tables may be longer (T1p rows on the Pallas step); the
+    reference tables are zero-padded up — rows past Tmax are garbage in
+    both and masked by the device loop."""
+    import jax.numpy as jnp
+
+    total_r, sub_r, ins_r, del_r = read_out
+    ref_score, sub_f, ins_f, del_f = ref_out
+
+    def padto(a, n):
+        return jnp.pad(a, ((0, n - a.shape[0]),) + ((0, 0),) * (a.ndim - 1))
+
+    return (
+        total_r + ref_score,
+        sub_r + padto(sub_f, sub_r.shape[0]),
+        ins_r + padto(ins_f, ins_r.shape[0]),
+        del_r + padto(del_f, del_r.shape[0]),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
+                         history_cap, Tmax, stop_on_same, Kc, T1pc, nrows,
+                         do_cins, do_cdel):
+    """Compiled device FRAME stage loop: Pallas read step + codon-engine
+    reference tables. step_state = ((FillBuffers, lengths, bandwidths,
+    weights), rt_arrays)."""
+    from ..ops.align_jax import BandGeometry
+    from ..ops.dense_pallas import fused_tables_pallas
+    from .device_loop import make_stage_runner
+
+    ref_tables = _frame_ref_tables(Tmax, Kc, T1pc, nrows, do_cins, do_cdel)
+
+    def step_fn(tmpl, tlen, s):
+        (bufs, lengths, bw, weights), rt = s
+        geom = BandGeometry.make(lengths, tlen, bw)
+        out = fused_tables_pallas(
+            tmpl, tlen, bufs, geom, weights, K, T1p, C,
+            interpret=_pallas_interpret(),
+        )
+        return _add_ref_tables(
+            (out["total"], out["sub"], out["ins"], out["del"]),
+            ref_tables(tmpl, tlen, rt), Tmax,
+        )
+
+    return make_stage_runner(
+        step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
+        do_subs=do_subs,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
+                      min_dist, history_cap, stop_on_same, Kc, T1pc, nrows,
+                      do_cins, do_cdel):
+    """Compiled device FRAME stage loop over the fused XLA scan step
+    (CPU equality tests / f64 runs). step_state = (((seq, match,
+    mismatch, ins, dels), lengths, bandwidths, weights), rt_arrays)."""
+    from ..ops.align_jax import BandGeometry
+    from ..ops.fused import fused_step_full, pack_layout
+    from .device_loop import make_stage_runner
+
+    lay = pack_layout(n_reads, T1, False)
+    ref_tables = _frame_ref_tables(Tmax, Kc, T1pc, nrows, do_cins, do_cdel)
+
+    def step_fn(tmpl, tlen, s):
+        ((seq, match, mismatch, ins, dels), lengths, bw, weights), rt = s
+        geom = BandGeometry.make(lengths, tlen, bw)
+        _, _, _, packed = fused_step_full(
+            tmpl[:Tmax], seq, match, mismatch, ins, dels, geom, weights,
+            K, False, False, chunk,
+        )
+        sub_t = packed[slice(*lay["sub"])].reshape(T1, 4)
+        ins_t = packed[slice(*lay["ins"])].reshape(T1, 4)
+        del_t = packed[slice(*lay["del"])]
+        return _add_ref_tables(
+            (packed[0], sub_t, ins_t, del_t),
+            ref_tables(tmpl, tlen, rt), Tmax,
+        )
+
+    return make_stage_runner(
+        step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
+        do_subs=do_subs,
+    )
 
 
 @functools.lru_cache(maxsize=64)

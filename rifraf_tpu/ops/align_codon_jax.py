@@ -397,7 +397,10 @@ def forward_codon(template, tlen, rt: RefTables, K: int, T1p: int,
         t_cols, jnp.asarray(tlen, jnp.int32), tuple(rt[:9]), K, T1p,
         nrows, want_moves, trim, skew, rt.do_cins, rt.do_cdel,
     )
-    return CodonBands(bands, moves, starts, score, int(tlen), K)
+    # tlen may be a tracer (the device FRAME loop fills under jit with a
+    # drifting consensus length); keep it as-is in the pytree then
+    tlen_out = int(tlen) if isinstance(tlen, (int, np.integer)) else tlen
+    return CodonBands(bands, moves, starts, score, tlen_out, K)
 
 
 def backward_codon(template, tlen, rt: RefTables, K: int, T1p: int):

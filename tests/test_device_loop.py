@@ -190,3 +190,33 @@ def test_device_loop_respects_max_iters():
         # a budget-truncated stage must NOT report convergence
         # (finish_stage only fires when the stage ended itself)
         assert base.state.converged == dev.state.converged
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,icorr", [(13, True), (29, False)])
+def test_device_frame_matches_host_loop(seed, icorr):
+    """FRAME as one device dispatch (reads step + codon reference
+    tables, seed_indels=False) must reproduce the host loop exactly —
+    including penalty-escalation re-entries, whose stop-on-same guard
+    follows the host's penalties_increased skip."""
+    REF_SCORES = Scores.from_error_model(ErrorModel(8.0, 0.1, 0.1, 1.0, 1.0))
+    rng = np.random.default_rng(seed)
+    ref, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=8, length=99, error_rate=0.06, rng=rng,
+        ref_error_rate=0.1, ref_errors=ErrorModel(8.0, 0.0, 0.0, 1.0, 1.0),
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    kw = dict(_EQ_KW, seed_indels=False, indel_correction_only=icorr,
+              ref_scores=REF_SCORES)
+    base = rifraf(seqs, phreds=phreds, reference=ref,
+                  params=RifrafParams(device_loop="off", **kw))
+    dev = rifraf(seqs, phreds=phreds, reference=ref,
+                 params=RifrafParams(device_loop="on", **kw))
+    assert np.array_equal(base.consensus, dev.consensus)
+    assert np.isclose(base.state.score, dev.state.score, rtol=1e-12)
+    assert base.state.stage_iterations.tolist() == \
+        dev.state.stage_iterations.tolist()
+    for a, b in zip(base.consensus_stages, dev.consensus_stages):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y)

@@ -78,7 +78,8 @@ def build_e2e_problem(tlen=TLEN, n_reads=N_READS, seed=0, error_rate=0.01):
     return template, seqs, phreds
 
 
-def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False):
+def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
+            device_loop=None):
     """One full consensus; returns (wall_seconds, result)."""
     from rifraf_tpu.engine.driver import rifraf
     from rifraf_tpu.engine.params import RifrafParams
@@ -107,6 +108,8 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False):
               "do_alignment_proposals": False}
     if bandwidth is not None:
         kw["bandwidth"] = bandwidth
+    if device_loop is not None:
+        kw["device_loop"] = device_loop
     params = RifrafParams(max_iters=max_iters, **kw)
     t0 = time.perf_counter()
     result = rifraf(seqs, phreds=phreds, params=params)
@@ -114,13 +117,15 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False):
 
 
 def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
-                max_iters=100, verbose=False, ref_default=False):
+                max_iters=100, verbose=False, ref_default=False,
+                device_loop=None):
     template, seqs, phreds = build_e2e_problem(tlen, n_reads)
     walls = []
     result = None
     for i in range(n_timed + 1):  # first run compiles
         wall, result = run_e2e(seqs, phreds, bandwidth=bandwidth,
-                               max_iters=max_iters, ref_default=ref_default)
+                               max_iters=max_iters, ref_default=ref_default,
+                               device_loop=device_loop)
         if verbose:
             label = "compile+run" if i == 0 else "warm"
             print(f"  run {i}: {wall:.2f}s ({label})", file=sys.stderr)
@@ -129,6 +134,29 @@ def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
     n_iters = int(result.state.stage_iterations.sum())
     recovered = bool(np.array_equal(result.consensus, template))
     return walls, n_iters, recovered, result
+
+
+# the device round-trip sections of Timers.data: every host-loop
+# iteration pays these once or more (the device-resident stage loop
+# replaces them with one dispatch + one fetch per STAGE)
+_DISPATCH_TIMERS = ("fused_dispatch", "packed_fetch", "moves_fetch",
+                    "adapt_dispatch", "adapt_fetch")
+
+
+def host_dispatch_stats(result, walls):
+    """Per-iteration host-dispatch latency of a finished run: wall time
+    and device round-trip seconds (dispatch + fetch timer sections)
+    divided by the hill-climb iteration count."""
+    n_iters = max(int(result.state.stage_iterations.sum()), 1)
+    data = result.timers.data
+    dispatch_s = sum(data[k][1] for k in _DISPATCH_TIMERS if k in data)
+    wall = min(walls)
+    return {
+        "iterations": n_iters,
+        "wall_per_iter_ms": round(wall / n_iters * 1000, 2),
+        "dispatch_per_iter_ms": round(dispatch_s / n_iters * 1000, 2),
+        "dispatch_seconds": round(dispatch_s, 3),
+    }
 
 
 def _step_mode():
@@ -269,8 +297,14 @@ def main():
         # recalibrate CPU_REF_DEFAULT_SECONDS)
         import jax
 
-        walls, it, rec, _ = measure_e2e(n_timed=2, verbose=True,
-                                        ref_default=True)
+        walls, it, rec, res = measure_e2e(n_timed=2, verbose=True,
+                                          ref_default=True)
+        # the same config pinned to the per-iteration host loop: what
+        # each iteration pays in device round-trips (the latency the
+        # device-resident stage loop amortizes into one dispatch/stage)
+        walls_h, _, _, res_h = measure_e2e(n_timed=2, verbose=True,
+                                           ref_default=True,
+                                           device_loop="off")
         print(json.dumps({
             "config": "ref_default_1kb_256",
             "backend": jax.default_backend(),
@@ -278,6 +312,9 @@ def main():
             "runs_s": [round(w, 3) for w in walls],
             "iterations": it,
             "template_recovered": rec,
+            "stage_paths": res.metadata["stage_paths"],
+            "host_loop": dict(host_dispatch_stats(res_h, walls_h),
+                              e2e_seconds=round(min(walls_h), 3)),
         }))
         return 0
 
@@ -319,8 +356,15 @@ def main():
         }
         # and the REFERENCE-DEFAULT parameter set (what cli/consensus.py
         # runs): fixed top-5 INIT batch, batch growth, alignment proposals
-        walls_rd, it_rd, rec_rd, _ = measure_e2e(
+        walls_rd, it_rd, rec_rd, res_rd = measure_e2e(
             n_timed=2, verbose=verbose, ref_default=True
+        )
+        # per-iteration host-dispatch latency of the SAME config with
+        # the device loop off: the round-trip cost the device-resident
+        # stage loop removes
+        walls_rh, _, _, res_rh = measure_e2e(
+            n_timed=2, verbose=verbose, ref_default=True,
+            device_loop="off"
         )
         rd = min(walls_rd)
         out["ref_default_1kb_256"] = {
@@ -328,6 +372,9 @@ def main():
             "runs_s": [round(w, 3) for w in walls_rd],
             "iterations": it_rd,
             "template_recovered": rec_rd,
+            "stage_paths": res_rd.metadata["stage_paths"],
+            "host_loop": dict(host_dispatch_stats(res_rh, walls_rh),
+                              e2e_seconds=round(min(walls_rh), 3)),
         }
         if CPU_REF_DEFAULT_SECONDS:
             out["ref_default_1kb_256"]["vs_baseline"] = round(

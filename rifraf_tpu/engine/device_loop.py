@@ -21,12 +21,22 @@ emission order == the flat layout's index order; both
 filter walks candidates in the same order, and the rollback uses the
 same np.isclose formula — asserted by tests/test_device_loop.py.
 
-Eligibility (enforced by the driver): full-batch (no subsampling),
-do_alignment_proposals=False (the dense tables score ALL edits anyway;
-the traceback-restricted candidate SET of model.jl:483-497 is a
-different algorithm), min_dist >= 2 (the vectorized apply relies on
-chosen proposals touching distinct anchors), bandwidths settled. Falls
-back to the host loop mid-stage (without losing work) when the
+The reference-default candidate algorithms run in-loop as GATES over
+the dense tables: ``gate="edits"`` masks candidate slots with the
+in-kernel edits_seen indicators (alignment_proposals' traceback
+restriction, model.jl:483-497), and ``gate="seeds"`` masks FRAME indels
+with the consensus-vs-reference seed anchors (model.jl:538-562,
+computed on device by ops.align_codon_jax.path_indel_columns). The
+gated score vector is NEG outside the restricted set, so ordering,
+choose_candidates, and rollback are untouched — bit-identity with the
+host loop holds gate-for-gate.
+
+Eligibility (enforced by the driver): a stable batch — full-batch, or
+batch_fixed's deterministic INIT/FRAME batch (driver.resample draws no
+randomness there, so host and device loops see identical reads),
+min_dist >= 2 (the vectorized apply relies on chosen proposals touching
+distinct anchors), bandwidths settled, no mesh sharding. Falls back to
+the host loop mid-stage (without losing work) when the
 improving-candidate count exceeds the top-k cap or the template drifts
 too far from its entry length for the compiled band margins.
 """
@@ -58,27 +68,48 @@ class StageResult(NamedTuple):
 
 
 def _candidate_scores(sub_t, ins_t, del_t, tmpl, tlen, total, do_indels,
-                      Tmax: int, do_subs: bool = True):
+                      Tmax: int, do_subs: bool = True,
+                      gate: str = "none", gates=None):
     """Flat candidate score vector in all_proposals' emission order:
     [Ins(0, b) x4] then per position j: [Sub(j, b) x4, Del(j),
     Ins(j+1, b) x4]. Ineligible slots (own-base substitutions, positions
     beyond tlen, subs/indels when disabled, non-improving) hold NEG.
     ``do_subs=False`` is FRAME's indel_correction_only gating
-    (model.jl:423-426)."""
+    (model.jl:423-426).
+
+    ``gate="edits"`` restricts slots to the edits observed in the read
+    tracebacks (alignment_proposals, model.jl:483-497): ``gates`` is the
+    [>= Tmax+1, 9] edits_seen indicator (cols 0-3 sub bases, 4-7 ins
+    bases, 8 del). ``gate="seeds"`` restricts FRAME indels to the
+    reference-alignment seed neighborhoods (model.jl:538-562): ``gates``
+    is ``(ins_gate, del_gate)``, anchor-indexed [>= Tmax+1] booleans
+    (Insertion(0) stays unconditional, matching all_proposals)."""
     j = jnp.arange(Tmax)
     live = j < tlen
     if do_subs:
-        sub = jnp.where(
-            live[:, None] & (jnp.arange(4)[None, :] != tmpl[:Tmax, None]),
-            sub_t[:Tmax],
-            NEG,
+        sub_ok = live[:, None] & (
+            jnp.arange(4)[None, :] != tmpl[:Tmax, None]
         )
+        if gate == "edits":
+            sub_ok = sub_ok & (gates[:Tmax, 0:4] != 0)
+        sub = jnp.where(sub_ok, sub_t[:Tmax], NEG)
     else:
         sub = jnp.full((Tmax, 4), NEG)
     if do_indels:
-        dele = jnp.where(live, del_t[:Tmax], NEG)
-        ins0 = ins_t[0]
-        ins_next = jnp.where((j[:, None] + 1) <= tlen, ins_t[1 : Tmax + 1], NEG)
+        del_ok = live
+        ins0_ok = jnp.ones((4,), bool)
+        ins_ok = (j[:, None] + 1) <= tlen
+        if gate == "edits":
+            del_ok = del_ok & (gates[:Tmax, 8] != 0)
+            ins0_ok = gates[0, 4:8] != 0
+            ins_ok = ins_ok & (gates[1 : Tmax + 1, 4:8] != 0)
+        elif gate == "seeds":
+            ins_gate, del_gate = gates
+            del_ok = del_ok & del_gate[1 : Tmax + 1]
+            ins_ok = ins_ok & ins_gate[1 : Tmax + 1][:, None]
+        dele = jnp.where(del_ok, del_t[:Tmax], NEG)
+        ins0 = jnp.where(ins0_ok, ins_t[0], NEG)
+        ins_next = jnp.where(ins_ok, ins_t[1 : Tmax + 1], NEG)
     else:
         dele = jnp.full((Tmax,), NEG)
         ins0 = jnp.full((4,), NEG)
@@ -186,13 +217,20 @@ def make_stage_runner(
     Tmax: int,
     stop_on_same: bool,
     do_subs: bool = True,
+    gate: str = "none",
 ):
     """Build the jitted whole-stage runner. ``step_fn`` takes the
     device-resident batch state as an ARGUMENT pytree (not a closure) so
     one compiled runner serves every batch of the same shape — callers
     cache via engine.realign's lru-cached factories. ``stop_on_same``
     mirrors check_score's full-batch stall exit (driver.check_score
-    requires batch_size == len(sequences) for it)."""
+    requires batch_size == len(sequences) for it).
+
+    With ``gate != "none"`` the step_fn returns a FIFTH element — the
+    gate pytree for the template it just scored (edits_seen array for
+    "edits", (ins_gate, del_gate) for "seeds") — which rides the carry
+    alongside the tables so candidate masking always matches the
+    template the tables describe."""
 
     def cond(carry):
         return jnp.logical_not(carry["done"]) & (
@@ -201,7 +239,8 @@ def make_stage_runner(
 
     def body(carry):
         tmpl, tlen = carry["tmpl"], carry["tlen"]
-        total, sub_t, ins_t, del_t = carry["tables"]
+        total, sub_t, ins_t, del_t = carry["tables"][:4]
+        gates = carry["tables"][4] if gate != "none" else None
         it = carry["it"]
         # record this iteration's starting consensus (the driver appends
         # to consensus_stages at every iteration top)
@@ -223,7 +262,7 @@ def make_stage_runner(
 
         cand = _candidate_scores(
             sub_t, ins_t, del_t, tmpl, tlen, total, do_indels, Tmax,
-            do_subs,
+            do_subs, gate, gates,
         )
         kind, pos, base, keep, n_improving, best = _choose(cand, min_dist)
         no_cand = n_improving == 0
@@ -243,9 +282,8 @@ def make_stage_runner(
             # handle_candidates: apply all chosen, re-score; if multiple
             # and the combination is no better than the best single,
             # roll back to the single best (which the next fill scores)
-            total2, sub2, ins2, del2 = step_fn(
-                tmpl_multi, tlen_multi, carry["step_state"]
-            )
+            out2 = step_fn(tmpl_multi, tlen_multi, carry["step_state"])
+            total2 = out2[0]
             rollback = (n_keep > 1) & (
                 (total2 < best) | _isclose(total2, best)
             )
@@ -258,12 +296,12 @@ def make_stage_runner(
                 )
 
             def multi(_):
-                return tmpl_multi, tlen_multi, (total2, sub2, ins2, del2)
+                return tmpl_multi, tlen_multi, out2
 
             return jax.lax.cond(rollback, single, multi, None)
 
         def no_work(_):
-            return tmpl, tlen, (total, sub_t, ins_t, del_t)
+            return tmpl, tlen, carry["tables"]
 
         tmpl_n, tlen_n, tables_n = jax.lax.cond(do_work, work, no_work, None)
         return {

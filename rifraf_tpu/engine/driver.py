@@ -81,6 +81,10 @@ class RifrafState:
     # device/host alignment engines (the As/Bs/Amoves caches)
     aligner: Optional[BatchAligner] = None
     ref_aligner: Optional[RefAligner] = None
+    # observability: (stage, reason) pairs already logged for device-loop
+    # declines, and stage name -> chosen execution path
+    device_declines: set = field(default_factory=set)
+    stage_paths: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -96,6 +100,9 @@ class RifrafResult:
     error_probs: Optional[EstimatedProbs] = None
     aln_error_probs: Optional[np.ndarray] = None
     timers: Optional[Timers] = None
+    # execution metadata: {"stage_paths": {stage name -> "device_loop" /
+    # "host (...reason...)" / "host"}} — which engine ran each stage
+    metadata: Optional[dict] = None
 
 
 def _log(params: RifrafParams, level: int, msg: str) -> None:
@@ -478,8 +485,13 @@ def _try_device_stage(
     """Run the remainder of the current stage as ONE device dispatch
     (engine.device_loop) when eligible; returns the StageResult or None
     for the host path. Bit-identical to the host loop by construction —
-    the candidate tables, tie order, min-dist filter, and rollback rule
-    all match (tests/test_device_loop.py)."""
+    the candidate tables, candidate gates (do_alignment_proposals edits,
+    seed_indels anchors), tie order, min-dist filter, and rollback rule
+    all match (tests/test_device_loop.py).
+
+    Config-level declines are logged ONCE per (stage, reason) at
+    verbose>=1, naming the disqualifying parameter, and recorded in
+    state.stage_paths (surfaced in RifrafResult.metadata)."""
     if params.device_loop == "off":
         return None
     if params.device_loop == "auto":
@@ -487,42 +499,80 @@ def _try_device_stage(
 
         if jax.default_backend() != "tpu":
             return None
-    if state.stage in (Stage.INIT, Stage.REFINE):
-        # the dense tables score ALL edits; the traceback-restricted
-        # candidate set of do_alignment_proposals is a different
-        # algorithm
-        if params.do_alignment_proposals:
-            return None
-    elif state.stage == Stage.FRAME:
-        # FRAME always uses all_proposals (alignment proposals are an
-        # INIT/REFINE-only mechanism), but indel SEEDING restricts the
-        # candidate set from the consensus-vs-reference alignment
-        # (model.jl:538-562) — a different algorithm the loop does not
-        # implement
-        if params.seed_indels:
-            return None
+
+    def decline(reason: str):
+        key = (state.stage, reason)
+        if key not in state.device_declines:
+            state.device_declines.add(key)
+            _log(params, 1,
+                 f"device loop declined for {state.stage.name}: {reason}")
+        # overwrite a plain "host" stamp from an earlier iteration: the
+        # reason is the useful part (a later device success overwrites
+        # this in turn)
+        state.stage_paths[state.stage.name] = f"host ({reason})"
+        return None
+
+    if state.stage == Stage.FRAME:
         if state.reference is None or not state.ref_built:
+            # transient: the reference scores are built on the
+            # INIT->FRAME edge; not a configuration refusal
             return None
-    else:
+        if params.seed_indels:
+            # the host computes indel seeds via _align_moves_routed: the
+            # numpy engine below DEVICE_THRESHOLD, the codon device
+            # engine above. The two break score TIES differently (the
+            # repo only guarantees path-score equality), so the in-loop
+            # seed gate — which always uses the device engine — is only
+            # bit-identical to the host when every in-loop template
+            # length stays in the device-routed regime. Drift inside the
+            # loop is bounded by MAX_DRIFT before it bails.
+            from ..ops.align_codon_jax import DEVICE_THRESHOLD
+            from .device_loop import MAX_DRIFT
+
+            if (len(state.consensus) - MAX_DRIFT < DEVICE_THRESHOLD
+                    or len(state.reference) < DEVICE_THRESHOLD):
+                return decline(
+                    "seed_indels with consensus/reference below the "
+                    "device alignment threshold (the host's numpy "
+                    "aligner breaks score ties differently)"
+                )
+    elif state.stage not in (Stage.INIT, Stage.REFINE):
         return None
     if params.min_dist < 2:
-        return None
+        return decline(
+            "min_dist < 2 (the vectorized apply needs separated anchors)"
+        )
     if params.verbose >= 2:
-        return None
-    # full batch only: with a partial batch, check_score's batch-growth
-    # branch (driver.check_score:337-352) can fire on a score regression,
-    # which the device loop does not implement — restricting to the
-    # full-batch configs keeps the bit-identity contract airtight
+        return decline("verbose >= 2 (per-iteration logging stays on host)")
+    if params.mesh is not None:
+        return decline("mesh is not None (the device loop is single-device)")
+    # batching: a full batch always qualifies; a PARTIAL batch only under
+    # batch_fixed INIT/FRAME — that selection is a deterministic stable
+    # argsort (resample draws no rng), and within a fixed batch
+    # check_score's growth branch needs a relative score DROP, which the
+    # improving-only hill climb cannot produce mid-stage
     full_batch = state.batch_size >= len(state.sequences)
-    if not full_batch:
+    fixed_partial = (
+        params.batch_fixed and state.stage in (Stage.INIT, Stage.FRAME)
+    )
+    if not (full_batch or fixed_partial):
+        return decline(
+            "batch_size < n_reads without batch_fixed "
+            "(stochastic per-iteration resampling)"
+        )
+    if state.aligner is None:
+        # first iteration of the run builds the aligner on the host
         return None
-    if state.aligner is None or not bool(state.aligner.fixed.all()):
-        return None
+    if not bool(state.aligner.fixed.all()):
+        return decline("read bandwidths still adapting")
     # the selection resample would make this iteration (deterministic for
-    # the stable configs; draws no rng)
+    # the eligible configs; draws no rng)
     resample(state, params, rng)
     if not _same_batch(state.aligner, state.batch_seqs):
-        return None
+        return decline("working batch differs from the aligner's batch")
+    # stop_on_same mirrors check_score's stall exit EXACTLY: that branch
+    # requires batch_size == len(sequences), so a fixed partial batch
+    # must run with the stall check off
     if state.stage == Stage.FRAME:
         runner = state.aligner.stage_runner_frame(
             len(state.consensus),
@@ -534,6 +584,7 @@ def _try_device_stage(
             # its stall test once (penalties_increased); the loop's
             # stop-on-same must not fire in its place
             stop_on_same=full_batch and not state.penalties_increased,
+            seed_gate=params.seed_indels,
         )
     else:
         runner = state.aligner.stage_runner(
@@ -542,9 +593,13 @@ def _try_device_stage(
             min_dist=params.min_dist,
             history_cap=params.max_iters + 1,
             stop_on_same=full_batch,
+            use_edits=params.do_alignment_proposals,
         )
     if runner is None:
-        return None
+        return decline(
+            "no whole-stage step engine fits (panel-mode template or "
+            "reference bandwidth unsettled)"
+        )
     stage_idx = int(state.stage) - 1
     res = runner(
         state.consensus,
@@ -552,6 +607,7 @@ def _try_device_stage(
         iters_left=iters_left,
         prev_iters=int(state.stage_iterations[stage_idx]),
     )
+    state.stage_paths[state.stage.name] = "device_loop"
     _log(params, 1,
          f"device stage {state.stage.name}: {res.n_iters} iterations, "
          f"score {res.score}")
@@ -753,6 +809,9 @@ def rifraf(
                 # overflow / template drift): let the host loop own the
                 # rest of this stage
                 device_blocked.add(state.stage)
+                state.stage_paths[state.stage.name] = (
+                    "device_loop (bailed to host)"
+                )
                 res = None
         if res is not None:
             iterations_used += res.n_iters
@@ -766,6 +825,7 @@ def rifraf(
         iterations_used += 1
         iteration = iterations_used
         state.stage_iterations[int(state.stage) - 1] += 1
+        state.stage_paths.setdefault(state.stage.name, "host")
         consensus_stages[int(state.stage) - 1].append(state.consensus.copy())
         _log(params, 1, f"iteration {iteration} : {state.stage.name} : {state.score}")
         # per-iteration consensus dump (model.jl:1164-1168)
@@ -819,6 +879,7 @@ def rifraf(
         state=state,
         consensus_stages=consensus_stages,
         timers=timers,
+        metadata={"stage_paths": dict(state.stage_paths)},
     )
     if params.do_score:
         _log(params, 2, "computing consensus quality scores")

@@ -113,18 +113,25 @@ def proposals_from_edits(
     union edit-indicator table (ops.align_jax._traceback_stats_one):
     rows = template positions, columns 0-3 substitution bases, 4-7
     insertion bases, 8 deletion. Yields the same SET as the host traceback
-    walk — the reference materializes it via a Set, so order was never
-    part of the contract — without ever fetching the move bands."""
+    walk — the reference materializes it via a Set, so the set order was
+    never part of the contract — without ever fetching the move bands.
+
+    Emission ORDER deliberately matches all_proposals (and the device
+    loop's flat candidate layout, engine.device_loop._candidate_scores):
+    choose_candidates breaks score ties by emission order, so host and
+    device runs stay bit-identical under the edits gate."""
     results: List[Proposal] = []
-    sub_pos, sub_base = np.nonzero(edits[:tlen, 0:4])
-    for p, b in zip(sub_pos, sub_base):
-        results.append(Substitution(int(p), int(b)))
     if do_indels:
-        ins_pos, ins_base = np.nonzero(edits[: tlen + 1, 4:8])
-        for p, b in zip(ins_pos, ins_base):
-            results.append(Insertion(int(p), int(b)))
-        for p in np.nonzero(edits[:tlen, 8])[0]:
-            results.append(Deletion(int(p)))
+        for b in np.nonzero(edits[0, 4:8])[0]:
+            results.append(Insertion(0, int(b)))
+    for j in range(tlen):
+        for b in np.nonzero(edits[j, 0:4])[0]:
+            results.append(Substitution(j, int(b)))
+        if do_indels:
+            if edits[j, 8]:
+                results.append(Deletion(j))
+            for b in np.nonzero(edits[j + 1, 4:8])[0]:
+                results.append(Insertion(j + 1, int(b)))
     return results
 
 

@@ -104,13 +104,21 @@ class RifrafParams:
     # as exp/align_pallas_gen1.py.
     backend: str = "auto"
     # whole-stage device-resident hill-climb (engine.device_loop): run
-    # each eligible INIT/REFINE stage as ONE lax.while_loop dispatch —
-    # one fetch per stage instead of per iteration. "auto": on when the
-    # stage qualifies (stable full batch, do_alignment_proposals=False,
-    # min_dist >= 2, settled bandwidths, verbose < 2) AND the backend is
-    # a real TPU (where the per-iteration fetch costs ~100 ms); "on":
+    # each eligible INIT/REFINE/FRAME stage as ONE lax.while_loop
+    # dispatch — one fetch per stage instead of per iteration.
+    # do_alignment_proposals (INIT/REFINE) is handled by an in-kernel
+    # edits gate over the dense candidate tables, and seed_indels
+    # (FRAME) by a device-computed consensus-vs-reference anchor gate
+    # (engages when the consensus/reference are long enough that the
+    # host would route the seed alignment through the same device
+    # engine). "auto": on when the stage qualifies (full batch or
+    # batch_fixed's deterministic INIT/FRAME batch, min_dist >= 2,
+    # settled bandwidths, verbose < 2, no mesh) AND the backend is a
+    # real TPU (where the per-iteration fetch costs ~100 ms); "on":
     # also on CPU (the loop is backend-agnostic; used by equality
-    # tests); "off": never.
+    # tests); "off": never. Config-level declines are logged once per
+    # stage at verbose >= 1 and surfaced in RifrafResult.metadata
+    # ["stage_paths"].
     device_loop: str = "auto"
 
 

@@ -510,14 +510,22 @@ class BatchAligner:
 
     # --- device-resident stage loop ---------------------------------------
     def stage_runner(self, tlen0: int, do_indels: bool, min_dist: int,
-                     history_cap: int, stop_on_same: bool):
+                     history_cap: int, stop_on_same: bool,
+                     use_edits: bool = False):
         """Jitted whole-stage hill-climb runner (engine.device_loop) over
         this batch, or None when no step engine fits. The compiled
         while-loop is cached at module level by static shape config
         (_pallas_stage_runner/_xla_stage_runner) — a fresh aligner with
         the same shapes reuses it; this method binds the batch's device
         state and returns a (consensus, prev_score, iters_left,
-        prev_iters) -> StageResult callable."""
+        prev_iters) -> StageResult callable.
+
+        ``use_edits`` adds the in-kernel traceback-statistics pass to
+        every step and masks candidates with the union edit indicators —
+        the device-resident do_alignment_proposals path (model.jl:
+        483-497). One divergence from the host path: the in-loop step
+        cannot raise on a malformed band (n_errors < 0) the way
+        realign(want_stats=True) does."""
         import jax.numpy as jnp
 
         from .device_loop import MAX_DRIFT
@@ -539,7 +547,7 @@ class BatchAligner:
         K = (self._pallas_K(tlen0, margin=MAX_DRIFT) if use_pallas
              else _bucket(self._K(tlen0) + MAX_DRIFT, 8))
         key = (Tmax, K, use_pallas, do_indels, min_dist, history_cap,
-               stop_on_same)
+               stop_on_same, use_edits)
         if key in self._stage_runners:
             return self._stage_runners[key]
 
@@ -556,7 +564,7 @@ class BatchAligner:
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_stage_runner(
                 K, T1p, C, do_indels, min_dist,
-                history_cap, Tmax, stop_on_same,
+                history_cap, Tmax, stop_on_same, use_edits,
             )
             state = (self._ensure_fill_bufs(), lengths_dev, bw_dev, weights)
         else:
@@ -565,7 +573,7 @@ class BatchAligner:
             weights = jnp.ones(n_reads, dtype=self.dtype)
             base = _xla_stage_runner(
                 K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
-                history_cap, stop_on_same,
+                history_cap, stop_on_same, use_edits,
             )
             state = (
                 (batch.seq, batch.match, batch.mismatch, batch.ins,
@@ -582,13 +590,22 @@ class BatchAligner:
 
     def stage_runner_frame(self, tlen0: int, ref: ReadScores,
                            indel_correction_only: bool, min_dist: int,
-                           history_cap: int, stop_on_same: bool):
+                           history_cap: int, stop_on_same: bool,
+                           seed_gate: bool = False):
         """Jitted whole-FRAME-stage runner: the read step plus the codon
         reference engine's dense all-edit tables, so penalty-escalation
         rounds of FRAME (model.jl:1150-1227 with reference scoring) run
         as one dispatch each. Same caching/bail contract as
         stage_runner; None when no engine fits (mesh, unsettled
-        bandwidths, or the reference's bandwidth not yet adapted)."""
+        bandwidths, or the reference's bandwidth not yet adapted).
+
+        ``seed_gate`` adds the seed_indels restriction (model.jl:538-562)
+        to every step: a SKEWED consensus-vs-reference alignment
+        (single_indel_proposals' skew_matches=True), the single-indel
+        emission columns of its optimal path (ops.align_codon_jax.
+        path_indel_columns — the device form of the host traceback walk),
+        and a +-CODON_LENGTH dilation yield anchor gates over the dense
+        FRAME indel candidates."""
         import jax.numpy as jnp
 
         from ..ops.align_codon_jax import (
@@ -619,9 +636,12 @@ class BatchAligner:
         # the hit must hold the SAME RefTables object: penalty
         # escalation rebuilds rt, and an id()-style key could collide
         # after GC and serve a runner closed over stale penalty tables
-        # (the same hazard align_codon_jax._ENGINE_CACHE guards)
+        # (the same hazard align_codon_jax._ENGINE_CACHE guards). The
+        # skewed tables derive from the same engine, so the rt identity
+        # check covers them too.
         key = ("frame", Tmax, K, use_pallas, do_subs, min_dist,
-               history_cap, stop_on_same, Kc, T1pc, nrows, ref.bandwidth)
+               history_cap, stop_on_same, Kc, T1pc, nrows, ref.bandwidth,
+               seed_gate)
         hit = self._stage_runners.get(key)
         if hit is not None and hit[0] is rt:
             return hit[1]
@@ -632,6 +652,8 @@ class BatchAligner:
         bw_dev = jnp.asarray(self.bandwidths)
         lengths_dev = jnp.asarray(self._lengths_host)
         rt9 = tuple(rt[:9])
+        if seed_gate:
+            rt9s = tuple(eng._tables(ref.bandwidth, True)[:9])
 
         if use_pallas:
             from ..ops.dense_pallas import pick_dense_cols
@@ -641,6 +663,7 @@ class BatchAligner:
             base = _pallas_frame_runner(
                 K, T1p, C, True, do_subs, min_dist, history_cap, Tmax,
                 stop_on_same, Kc, T1pc, nrows, rt.do_cins, rt.do_cdel,
+                seed_gate,
             )
             read_state = (self._ensure_fill_bufs(), lengths_dev, bw_dev,
                           weights)
@@ -651,14 +674,15 @@ class BatchAligner:
             base = _xla_frame_runner(
                 K, T1, Tmax, chunk, n_reads, True, do_subs, min_dist,
                 history_cap, stop_on_same, Kc, T1pc, nrows,
-                rt.do_cins, rt.do_cdel,
+                rt.do_cins, rt.do_cdel, seed_gate,
             )
             read_state = (
                 (batch.seq, batch.match, batch.mismatch, batch.ins,
                  batch.dels),
                 lengths_dev, bw_dev, weights,
             )
-        state = (read_state, rt9)
+        state = ((read_state, rt9, rt9s) if seed_gate
+                 else (read_state, rt9))
 
         def runner(consensus, prev_score, iters_left, prev_iters=0):
             return base(consensus, prev_score, iters_left, prev_iters,
@@ -1069,13 +1093,54 @@ def _add_ref_tables(read_out, ref_out, Tmax: int):
     )
 
 
+def _frame_seed_gates(tmpl, tlen, rt9s, Kc: int, T1pc: int, nrows: int,
+                      do_cins: bool, do_cdel: bool, Tmax: int):
+    """Device seed_indels gate (model.jl:538-562 + all_proposals'
+    neighborhoods): skewed consensus-vs-reference fill with moves, the
+    optimal path's single-indel emission columns, dilated by
+    +-CODON_LENGTH in anchor space. Returns (ins_gate, del_gate), both
+    [Tmax + 1] anchor-indexed booleans; with no seeds at all the gates
+    open fully (all_proposals' no_seeds). The host clamps deletion
+    neighborhoods to anchor >= 1 and both to anchor <= length — free
+    here, since the device loop only ever queries anchors 1..tlen."""
+    import jax.numpy as jnp
+
+    from ..ops.align_codon_jax import (
+        RefTables,
+        forward_codon,
+        path_indel_columns,
+    )
+    from ..utils.constants import CODON_LENGTH
+
+    rts = RefTables(*rt9s, do_cins=do_cins, do_cdel=do_cdel)
+    # the skew is baked into rt9s (make_ref_tables(skew=True)) — same
+    # single application as the host's align_moves(skew_matches=True)
+    fwd = forward_codon(tmpl[:Tmax], tlen, rts, Kc, T1pc, want_moves=True)
+    ins_col, del_col = path_indel_columns(
+        fwd.moves, fwd.starts, rts.slen, tlen, Kc, nrows + Kc, do_cins
+    )
+
+    def dilate(col):
+        out = col
+        for s in range(1, CODON_LENGTH + 1):
+            z = jnp.zeros((s,), bool)
+            out = out | jnp.concatenate([col[s:], z]) \
+                      | jnp.concatenate([z, col[:-s]])
+        return out
+
+    any_seed = jnp.any(ins_col) | jnp.any(del_col)
+    ins_gate = jnp.where(any_seed, dilate(ins_col), True)[: Tmax + 1]
+    del_gate = jnp.where(any_seed, dilate(del_col), True)[: Tmax + 1]
+    return ins_gate, del_gate
+
+
 @functools.lru_cache(maxsize=32)
 def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
                          history_cap, Tmax, stop_on_same, Kc, T1pc, nrows,
-                         do_cins, do_cdel):
+                         do_cins, do_cdel, seed_gate=False):
     """Compiled device FRAME stage loop: Pallas read step + codon-engine
     reference tables. step_state = ((FillBuffers, lengths, bandwidths,
-    weights), rt_arrays)."""
+    weights), rt_arrays[, skewed rt_arrays])."""
     from ..ops.align_jax import BandGeometry
     from ..ops.dense_pallas import fused_tables_pallas
     from .device_loop import make_stage_runner
@@ -1083,30 +1148,39 @@ def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
     ref_tables = _frame_ref_tables(Tmax, Kc, T1pc, nrows, do_cins, do_cdel)
 
     def step_fn(tmpl, tlen, s):
-        (bufs, lengths, bw, weights), rt = s
+        if seed_gate:
+            (bufs, lengths, bw, weights), rt, rts = s
+        else:
+            (bufs, lengths, bw, weights), rt = s
         geom = BandGeometry.make(lengths, tlen, bw)
         out = fused_tables_pallas(
             tmpl, tlen, bufs, geom, weights, K, T1p, C,
             interpret=_pallas_interpret(),
         )
-        return _add_ref_tables(
+        base = _add_ref_tables(
             (out["total"], out["sub"], out["ins"], out["del"]),
             ref_tables(tmpl, tlen, rt), Tmax,
         )
+        if seed_gate:
+            return base + (_frame_seed_gates(
+                tmpl, tlen, rts, Kc, T1pc, nrows, do_cins, do_cdel, Tmax
+            ),)
+        return base
 
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
-        do_subs=do_subs,
+        do_subs=do_subs, gate="seeds" if seed_gate else "none",
     )
 
 
 @functools.lru_cache(maxsize=32)
 def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
                       min_dist, history_cap, stop_on_same, Kc, T1pc, nrows,
-                      do_cins, do_cdel):
+                      do_cins, do_cdel, seed_gate=False):
     """Compiled device FRAME stage loop over the fused XLA scan step
     (CPU equality tests / f64 runs). step_state = (((seq, match,
-    mismatch, ins, dels), lengths, bandwidths, weights), rt_arrays)."""
+    mismatch, ins, dels), lengths, bandwidths, weights), rt_arrays[,
+    skewed rt_arrays])."""
     from ..ops.align_jax import BandGeometry
     from ..ops.fused import fused_step_full, pack_layout
     from .device_loop import make_stage_runner
@@ -1115,7 +1189,12 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
     ref_tables = _frame_ref_tables(Tmax, Kc, T1pc, nrows, do_cins, do_cdel)
 
     def step_fn(tmpl, tlen, s):
-        ((seq, match, mismatch, ins, dels), lengths, bw, weights), rt = s
+        if seed_gate:
+            ((seq, match, mismatch, ins, dels), lengths, bw, weights), \
+                rt, rts = s
+        else:
+            ((seq, match, mismatch, ins, dels), lengths, bw, weights), \
+                rt = s
         geom = BandGeometry.make(lengths, tlen, bw)
         _, _, _, packed = fused_step_full(
             tmpl[:Tmax], seq, match, mismatch, ins, dels, geom, weights,
@@ -1124,20 +1203,25 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
         sub_t = packed[slice(*lay["sub"])].reshape(T1, 4)
         ins_t = packed[slice(*lay["ins"])].reshape(T1, 4)
         del_t = packed[slice(*lay["del"])]
-        return _add_ref_tables(
+        base = _add_ref_tables(
             (packed[0], sub_t, ins_t, del_t),
             ref_tables(tmpl, tlen, rt), Tmax,
         )
+        if seed_gate:
+            return base + (_frame_seed_gates(
+                tmpl, tlen, rts, Kc, T1pc, nrows, do_cins, do_cdel, Tmax
+            ),)
+        return base
 
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
-        do_subs=do_subs,
+        do_subs=do_subs, gate="seeds" if seed_gate else "none",
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
-                         history_cap, Tmax, stop_on_same):
+                         history_cap, Tmax, stop_on_same, use_edits=False):
     """Compiled device stage loop over the Pallas fill+dense step, shared
     across aligners of identical shape config. step_state =
     (FillBuffers, lengths, bandwidths, weights)."""
@@ -1150,18 +1234,22 @@ def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
         geom = BandGeometry.make(lengths, tlen, bw)
         out = fused_tables_pallas(
             tmpl, tlen, bufs, geom, weights, K, T1p, C,
-            interpret=_pallas_interpret(),
+            want_stats=use_edits, interpret=_pallas_interpret(),
         )
-        return out["total"], out["sub"], out["ins"], out["del"]
+        base = (out["total"], out["sub"], out["ins"], out["del"])
+        if use_edits:
+            return base + (out["edits"],)
+        return base
 
     return make_stage_runner(
-        step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same
+        step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
+        gate="edits" if use_edits else "none",
     )
 
 
 @functools.lru_cache(maxsize=64)
 def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
-                      history_cap, stop_on_same):
+                      history_cap, stop_on_same, use_edits=False):
     """Compiled device stage loop over the fused XLA scan step (any
     backend / f64 exactness runs). step_state = ((seq, match, mismatch,
     ins, dels), lengths, bandwidths, weights)."""
@@ -1169,22 +1257,26 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
     from ..ops.fused import fused_step_full, pack_layout
     from .device_loop import make_stage_runner
 
-    lay = pack_layout(n_reads, T1, False)
+    lay = pack_layout(n_reads, T1, use_edits)
 
     def step_fn(tmpl, tlen, s):
         (seq, match, mismatch, ins, dels), lengths, bw, weights = s
         geom = BandGeometry.make(lengths, tlen, bw)
         _, _, _, packed = fused_step_full(
             tmpl[:Tmax], seq, match, mismatch, ins, dels, geom, weights,
-            K, False, False, chunk,
+            K, False, use_edits, chunk,
         )
         sub_t = packed[slice(*lay["sub"])].reshape(T1, 4)
         ins_t = packed[slice(*lay["ins"])].reshape(T1, 4)
         del_t = packed[slice(*lay["del"])]
-        return packed[0], sub_t, ins_t, del_t
+        base = (packed[0], sub_t, ins_t, del_t)
+        if use_edits:
+            return base + (packed[slice(*lay["edits"])].reshape(T1, 9),)
+        return base
 
     return make_stage_runner(
-        step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same
+        step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
+        gate="edits" if use_edits else "none",
     )
 
 

@@ -512,6 +512,94 @@ def count_errors_codon(moves: np.ndarray, starts: np.ndarray, slen: int,
     return n
 
 
+@functools.partial(jax.jit, static_argnames=("K", "R", "do_cins"))
+def path_indel_columns(moves, starts, slen, tlen, K: int, R: int,
+                       do_cins: bool):
+    """Which columns of the optimal path contain a single-indel move —
+    the device-side equivalent of backtrace_codon +
+    generate.single_indel_proposals' emission columns (model.jl:538-562):
+    an INSERT move in column j emits Insertion(j, .) (anchor j), a DELETE
+    move in column j emits Deletion(j - 1) (anchor j), codon moves emit
+    nothing. Returns (ins_col, del_col): [T1p] booleans over columns.
+
+    Works by backward reachability over the move band: each cell's
+    recorded move points at exactly ONE predecessor, so the set reachable
+    from (slen, tlen) is precisely the traceback path — no host fetch of
+    the move band needed. The scan walks columns high-to-low carrying
+    pending row-sets for the next three columns (MATCH/DELETE feed
+    column j-1, CODON_DELETE feeds column j-3); within a column, INSERT
+    chains (pred = previous row, same column) are closed in one shot by
+    an exact integer segment trick, and CODON_INSERT edges (pred = three
+    rows down, same column) by a tiny fixpoint loop.
+
+    ``R`` must be >= max row + K (callers pass nrows + K) so pending
+    row-sets can hold any band window."""
+    d = jnp.arange(K)
+
+    def close_column(pend, mv):
+        # reversed slot space e = K-1-d: row-decreasing edges point in
+        # +e direction, so closure is a forward scan
+        ins_e = (mv == TRACE_INSERT)[::-1]
+        # e and e+1 connect iff slot e (rev) holds an INSERT move; a
+        # segment id that increments at every broken edge makes
+        # "reachable from some pending slot in my segment" an exact
+        # integer cummax test (float cumsums would lose precision here)
+        brk = jnp.concatenate([
+            jnp.ones((1,), jnp.int32),
+            jnp.logical_not(ins_e[:-1]).astype(jnp.int32),
+        ])
+        seg = jnp.cumsum(brk)
+
+        def close1(p):
+            return p | (jax.lax.cummax(jnp.where(p, seg, -1)) == seg)
+
+        on = close1(pend[::-1])
+        if do_cins:
+            cins_e = (mv == TRACE_CODON_INSERT)[::-1]
+
+            def relax(state):
+                cur, _ = state
+                add = jnp.concatenate([
+                    jnp.zeros((CODON_LENGTH,), bool),
+                    (cur & cins_e)[:-CODON_LENGTH],
+                ])
+                nxt = close1(cur | add)
+                return nxt, jnp.any(nxt & jnp.logical_not(cur))
+
+            on, _ = jax.lax.while_loop(
+                lambda s: s[1], relax, (on, jnp.asarray(True))
+            )
+        return on[::-1]
+
+    def step(carry, x):
+        p1, p2, p3 = carry
+        mv, st, j = x
+        pend = jax.lax.dynamic_slice(p1, (st,), (K,))
+        pend = pend | ((j == tlen) & (d == slen - st))
+        on = close_column(pend, mv)
+        del_on = on & (mv == TRACE_DELETE)
+        ins_any = jnp.any(on & (mv == TRACE_INSERT))
+        del_any = jnp.any(del_on)
+        zero = jnp.zeros((R,), bool)
+        m_rows = jax.lax.dynamic_update_slice(
+            zero, on & (mv == TRACE_MATCH), (st,)
+        )
+        # MATCH pred is (i-1, j-1): shift the row-set down one
+        m_rows = jnp.concatenate([m_rows[1:], zero[:1]])
+        d_rows = jax.lax.dynamic_update_slice(zero, del_on, (st,))
+        c_rows = jax.lax.dynamic_update_slice(
+            zero, on & (mv == TRACE_CODON_DELETE), (st,)
+        )
+        return (p2 | m_rows | d_rows, p3, c_rows), (ins_any, del_any)
+
+    zero = jnp.zeros((R,), bool)
+    js = jnp.arange(moves.shape[0], dtype=jnp.int32)
+    _, (ins_col, del_col) = jax.lax.scan(
+        step, (zero, zero, zero), (moves, starts, js), reverse=True
+    )
+    return ins_col, del_col
+
+
 # --- proposal scoring (model.jl:302-383 / engine.scoring_np) -------------
 
 KIND_SUB, KIND_DEL, KIND_INS = 0, 1, 2
@@ -687,8 +775,11 @@ class CodonDeviceAligner:
         tpl = np.zeros(Tmax, np.int8)
         tpl[:tlen] = consensus
         tpl_dev = jnp.asarray(tpl)
+        # the skew variant is baked into rt already — passing skew here
+        # too would double-apply the 0.99 mismatch factor and diverge
+        # from the numpy engine's single application
         fwd = forward_codon(tpl_dev, tlen, rt, K, T1p,
-                            want_moves=want_moves, skew=skew)
+                            want_moves=want_moves, skew=False)
         bwd = (backward_codon(tpl_dev, tlen, rt, K, T1p)
                if want_backward else None)
         tpl_cols = np.zeros(T1p, np.int8)

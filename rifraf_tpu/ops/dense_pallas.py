@@ -50,6 +50,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pallas renamed TPUCompilerParams -> CompilerParams across jax releases;
+# accept either so the kernel builds on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 from .align_jax import BandGeometry
 from .fill_pallas import (
     LANES,
@@ -295,7 +299,7 @@ def dense_call(
         out_shape=jax.ShapeDtypeStruct(
             (NB, n_steps, C * ROWS, LANES), jnp.float32
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

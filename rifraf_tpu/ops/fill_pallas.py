@@ -73,6 +73,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pallas renamed TPUCompilerParams -> CompilerParams across jax releases;
+# accept either so the kernel builds on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 from .align_jax import BandGeometry
 from .align_np import (
     TRACE_DELETE,
@@ -434,7 +438,7 @@ def _fill_call(
             pltpu.VMEM((K, LANES), jnp.float32),
             pltpu.VMEM((1, LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

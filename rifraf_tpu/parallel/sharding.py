@@ -36,6 +36,19 @@ from ..ops.proposal_jax import _score_one_read
 READS_AXIS = "reads"
 
 
+def _shard_map(*args, **kwargs):
+    """jax.shard_map across the API migration: older releases keep it in
+    jax.experimental.shard_map and call the varying-axes check check_rep
+    instead of check_vma."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return shard_map(*args, **kwargs)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = READS_AXIS) -> Mesh:
     """A 1-D device mesh over the read (or cluster) axis."""
     devices = np.array(jax.devices())
@@ -138,7 +151,6 @@ def mesh_fill_buffers(mesh: Mesh, batch: ReadBatch, Npad_local: int):
     """Per-shard FillBuffers (ops.fill_pallas) built under shard_map from
     a read-sharded batch; the returned (global-view) buffers keep their
     lane axis sharded with Npad_local lanes per device."""
-    from jax import shard_map
 
     from ..ops.fill_pallas import FillBuffers, build_fill_buffers
 
@@ -153,7 +165,7 @@ def mesh_fill_buffers(mesh: Mesh, batch: ReadBatch, Npad_local: int):
         dels_T=lanes2, rseq_T=lanes2, rmatch_T=lanes2, rmismatch_T=lanes2,
         rins_T=lanes2, rdels_T=lanes2, lengths=P(READS_AXIS),
     )
-    fn = shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(P(READS_AXIS, None),) * 5 + (P(READS_AXIS),),
         out_specs=out_specs,
@@ -189,7 +201,6 @@ def mesh_fused_step_pallas(
     (packed, moves-or-None); packed follows pack_layout_pallas with
     Npad = n_devices * Npad_local (per-shard lane padding preserved —
     map read r to slot (r // Nlocal) * Npad_local + r % Nlocal)."""
-    from jax import shard_map
 
     from ..ops.dense_pallas import fused_tables_pallas
 
@@ -235,7 +246,7 @@ def mesh_fused_step_pallas(
         part_specs += [shard, rep]
     part_specs += [rep, rep, rep]
     assert len(part_specs) == n_parts
-    fn = shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(
             P(), P(),
@@ -266,7 +277,6 @@ def mesh_fill_stats_pallas(
     move recording + traceback stats. Returns packed
     [scores (Npad), n_errors (Npad)] with the per-shard lane layout of
     mesh_fused_step_pallas."""
-    from jax import shard_map
 
     from ..ops.dense_pallas import fill_stats_pallas
 
@@ -280,7 +290,7 @@ def mesh_fill_stats_pallas(
         Npad_l = bufs_l.seq_T.shape[1]
         return packed[:Npad_l], packed[Npad_l:]
 
-    fn = shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(
             P(), P(),

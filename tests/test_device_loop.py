@@ -220,3 +220,171 @@ def test_device_frame_matches_host_loop(seed, icorr):
         assert len(a) == len(b)
         for x, y in zip(a, b):
             assert np.array_equal(x, y)
+
+
+def _assert_runs_equal(base, dev):
+    assert np.array_equal(base.consensus, dev.consensus)
+    assert np.isclose(base.state.score, dev.state.score, rtol=1e-12)
+    assert base.state.stage_iterations.tolist() == \
+        dev.state.stage_iterations.tolist()
+    for a, b in zip(base.consensus_stages, dev.consensus_stages):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed,use_ref", [(5, False), (13, True), (21, True)])
+def test_device_loop_matches_host_alignment_proposals(seed, use_ref):
+    """do_alignment_proposals=True as a device stage: the in-kernel
+    edits indicators must reproduce the host's traceback-restricted
+    candidate set (engine.generate.alignment_proposals semantics)
+    bit-for-bit — consensus, score, iteration counts, full history."""
+    REF_SCORES = Scores.from_error_model(ErrorModel(8.0, 0.1, 0.1, 1.0, 1.0))
+    rng = np.random.default_rng(seed)
+    ref, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=8, length=100, error_rate=0.05, rng=rng,
+        ref_error_rate=0.1, ref_errors=ErrorModel(8.0, 0.0, 0.0, 1.0, 1.0),
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    r = ref if use_ref else None
+    kw = dict(batch_size=0, batch_fixed=False, do_alignment_proposals=True,
+              ref_scores=REF_SCORES)
+    base = rifraf(seqs, phreds=phreds, reference=r,
+                  params=RifrafParams(device_loop="off", **kw))
+    dev = rifraf(seqs, phreds=phreds, reference=r,
+                 params=RifrafParams(device_loop="on", **kw))
+    _assert_runs_equal(base, dev)
+    assert dev.metadata["stage_paths"]["INIT"] == "device_loop"
+
+
+@pytest.mark.slow
+def test_device_loop_matches_host_fixed_partial_batch():
+    """batch_fixed's partial INIT/FRAME batch is a deterministic stable
+    argsort (no rng draw), so the device loop now takes it; the host and
+    device runs must still agree exactly. REFINE grows to the full batch
+    only for full-batch configs, so it stays on host here."""
+    REF_SCORES = Scores.from_error_model(ErrorModel(8.0, 0.1, 0.1, 1.0, 1.0))
+    rng = np.random.default_rng(3)
+    ref, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=8, length=100, error_rate=0.05, rng=rng,
+        ref_error_rate=0.1, ref_errors=ErrorModel(8.0, 0.0, 0.0, 1.0, 1.0),
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    kw = dict(batch_size=5, batch_fixed=True, do_alignment_proposals=True,
+              ref_scores=REF_SCORES)
+    base = rifraf(seqs, phreds=phreds, reference=ref,
+                  params=RifrafParams(device_loop="off", **kw))
+    dev = rifraf(seqs, phreds=phreds, reference=ref,
+                 params=RifrafParams(device_loop="on", **kw))
+    _assert_runs_equal(base, dev)
+    assert dev.metadata["stage_paths"]["INIT"] == "device_loop"
+
+
+@pytest.mark.slow
+def test_device_frame_seed_gate_matches_host_loop():
+    """seed_indels FRAME as one dispatch: the device-computed
+    consensus-vs-reference anchor gate (model.jl:538-562 semantics) must
+    reproduce the host's seeded candidate restriction bit-for-bit,
+    including penalty-escalation re-entries. Lengths sit above
+    ops.align_codon_jax.DEVICE_THRESHOLD so the host's own seed
+    alignment routes through the same device engine (below it the numpy
+    engine breaks score ties differently and the driver declines)."""
+    REF_SCORES = Scores.from_error_model(ErrorModel(8.0, 0.1, 0.1, 1.0, 1.0))
+    rng = np.random.default_rng(17)
+    ref, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=6, length=600, error_rate=0.05, rng=rng,
+        ref_error_rate=0.1, ref_errors=ErrorModel(8.0, 0.0, 0.0, 1.0, 1.0),
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    kw = dict(batch_size=0, batch_fixed=False, do_alignment_proposals=True,
+              seed_indels=True, ref_scores=REF_SCORES)
+    base = rifraf(seqs, phreds=phreds, reference=ref,
+                  params=RifrafParams(device_loop="off", **kw))
+    dev = rifraf(seqs, phreds=phreds, reference=ref,
+                 params=RifrafParams(device_loop="on", **kw))
+    _assert_runs_equal(base, dev)
+    assert dev.metadata["stage_paths"]["FRAME"] == "device_loop"
+
+
+def test_seed_gate_declines_below_device_threshold():
+    """Short consensus/reference: the host computes indel seeds with the
+    numpy aligner, whose tie-breaking the device engine does not
+    reproduce — the driver must decline the FRAME device loop and say
+    why in the result metadata."""
+    REF_SCORES = Scores.from_error_model(ErrorModel(8.0, 0.1, 0.1, 1.0, 1.0))
+    rng = np.random.default_rng(13)
+    ref, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=6, length=100, error_rate=0.05, rng=rng,
+        ref_error_rate=0.1, ref_errors=ErrorModel(8.0, 0.0, 0.0, 1.0, 1.0),
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    dev = rifraf(seqs, phreds=phreds, reference=ref,
+                 params=RifrafParams(device_loop="on", batch_size=0,
+                                     batch_fixed=False, seed_indels=True,
+                                     do_alignment_proposals=False,
+                                     ref_scores=REF_SCORES))
+    path = dev.metadata["stage_paths"]["FRAME"]
+    assert path.startswith("host (")
+    assert "threshold" in path
+
+
+def test_default_config_selects_device_loop(monkeypatch):
+    """Path-selection only, no compiled equality: with pure default
+    params (do_alignment_proposals=True — the reference-default
+    candidate algorithm) and device_loop='on', the driver must REQUEST a
+    whole-stage runner with the edits gate enabled. The stub returns
+    None so nothing device-side compiles."""
+    from rifraf_tpu.engine import realign as realign_mod
+
+    calls = []
+    orig = realign_mod.BatchAligner.stage_runner
+
+    def spy(self, tlen0, do_indels, min_dist, history_cap, stop_on_same,
+            use_edits=False):
+        calls.append({"use_edits": use_edits, "do_indels": do_indels})
+        return None
+
+    monkeypatch.setattr(realign_mod.BatchAligner, "stage_runner", spy)
+    rng = np.random.default_rng(5)
+    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=8, length=100, error_rate=0.05, rng=rng,
+        seq_errors=ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0),
+    )
+    res = rifraf(seqs, phreds=phreds,
+                 params=RifrafParams(device_loop="on"))
+    assert calls, "driver never requested a whole-stage runner"
+    assert all(c["use_edits"] for c in calls)
+    # the stub declined, so the work itself ran on host and said why
+    assert res.metadata["stage_paths"]["INIT"].startswith("host (")
+    assert orig is not realign_mod.BatchAligner.stage_runner
+
+
+@pytest.mark.parametrize("stage_name,icorr", [
+    ("INIT", False), ("REFINE", False), ("FRAME", True), ("FRAME", False),
+])
+def test_candidate_layout_counts_match_generate(stage_name, icorr):
+    """The dense device layout and engine.generate.all_proposals must
+    agree on the candidate COUNT for every (do_subs, do_indels)
+    combination — ungated, uniform tables, so every live slot counts."""
+    from rifraf_tpu.engine.generate import all_proposals
+    from rifraf_tpu.engine.params import Stage
+
+    stage = Stage[stage_name]
+    rng = np.random.default_rng(2)
+    Tmax = 48
+    tlen = 37
+    tmpl = rng.integers(0, 4, size=Tmax).astype(np.int8)
+    do_subs = stage != Stage.FRAME or not icorr
+    do_indels = stage in (Stage.INIT, Stage.FRAME)
+
+    ones4 = jnp.ones((Tmax, 4), jnp.float32)
+    cand = dl._candidate_scores(
+        ones4, jnp.ones((Tmax + 1, 4), jnp.float32),
+        jnp.ones((Tmax,), jnp.float32), jnp.asarray(tmpl),
+        jnp.int32(tlen), jnp.float32(0.0), do_indels, Tmax,
+        do_subs=do_subs,
+    )
+    n_live = int(np.sum(np.asarray(cand) > float(dl.NEG) / 2))
+    want = len(all_proposals(stage, tmpl[:tlen], icorr))
+    assert n_live == want

@@ -36,6 +36,10 @@ Other modes (results appended to BASELINE.md, not the driver JSON):
   --step       the round-2 fused-step microbenchmark (proposal-scores/s)
   --northstar  2048 x 1 kb and 10 kb x 512 x band-64 end-to-end configs
   --golden     the shipped-data CLI run (vs the reference's 3.6 s anchor)
+  --sweep      heterogeneous 2048-cluster sharded sweep (log-normal read
+               lengths): bucketed vs uniform scheduler seconds and
+               padding-waste ratios (--sweep-n / --sweep-chunk override
+               the cluster count / chunk size for smoke runs)
   --quick      headline only (skip the north-star / ref-default extras)
 """
 
@@ -267,6 +271,78 @@ def _golden_mode():
     }))
 
 
+def _sweep_mode():
+    """Heterogeneous multi-cluster sweep: bucketed vs uniform scheduler
+    (parallel.sweep_sharded), same inputs, bit-identical results."""
+    import jax
+
+    from rifraf_tpu.engine.params import RifrafParams
+    from rifraf_tpu.models.errormodel import ErrorModel
+    from rifraf_tpu.models.sequences import make_read_scores
+    from rifraf_tpu.parallel.sharding import make_mesh
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+    from rifraf_tpu.sim.sample import sample_sequences
+    from rifraf_tpu.utils.phred import phred_to_log_p
+
+    n_clusters = 2048
+    if "--sweep-n" in sys.argv:
+        n_clusters = int(sys.argv[sys.argv.index("--sweep-n") + 1])
+    chunk = 256
+    if "--sweep-chunk" in sys.argv:
+        chunk = int(sys.argv[sys.argv.index("--sweep-chunk") + 1])
+
+    rng = np.random.default_rng(12)
+    params = RifrafParams()
+    seq_errors = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+    clusters = []
+    for _ in range(n_clusters):
+        # log-normal template lengths and ragged cluster sizes: the
+        # realistic amplicon mix whose pad-to-global-maxima cost the
+        # bucketed scheduler exists to avoid
+        tlen = int(np.clip(rng.lognormal(np.log(250), 0.5), 60, 1500))
+        nseqs = int(rng.integers(3, 13))
+        _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+            nseqs=nseqs, length=tlen, error_rate=0.02, rng=rng,
+            seq_errors=seq_errors,
+        )
+        clusters.append([
+            make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                             params.bandwidth, params.scores)
+            for s, p in zip(seqs, phreds)
+        ])
+
+    mesh = make_mesh() if len(jax.devices()) > 1 else None
+    out = {
+        "config": f"sweep_het_{n_clusters}",
+        "backend": jax.default_backend(),
+        "n_clusters": n_clusters,
+        "cluster_chunk": chunk,
+    }
+    results = {}
+    for sched in ("bucketed", "uniform"):
+        # warm-up compiles every shape signature; the timed run reuses
+        # the cached executables (the production steady state)
+        sweep_clusters_sharded(clusters, mesh=mesh, cluster_chunk=chunk,
+                               scheduler=sched)
+        res, stats = sweep_clusters_sharded(
+            clusters, mesh=mesh, cluster_chunk=chunk, scheduler=sched,
+            return_stats=True,
+        )
+        results[sched] = res
+        out[f"{sched}_seconds"] = round(stats.seconds, 3)
+        out[f"{sched}_waste"] = round(stats.waste, 4)
+        if sched == "bucketed":
+            out["n_buckets"] = stats.n_buckets
+    out["speedup"] = round(
+        out["uniform_seconds"] / out["bucketed_seconds"], 2
+    )
+    out["results_identical"] = all(
+        np.array_equal(a.consensus, b.consensus) and a.score == b.score
+        for a, b in zip(results["bucketed"], results["uniform"])
+    )
+    print(json.dumps(out))
+
+
 def main():
     if "--cpu" in sys.argv:
         import os
@@ -291,6 +367,9 @@ def main():
         return 0
     if "--golden" in sys.argv:
         _golden_mode()
+        return 0
+    if "--sweep" in sys.argv:
+        _sweep_mode()
         return 0
     if "--refdefault" in sys.argv:
         # standalone ref-default measurement (use with --cpu to

@@ -83,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --sharded-sweep: process the cluster axis in "
                         "sequential chunks of this size (bounds HBM); "
                         "0 = all at once")
+    p.add_argument("--sweep-bucket", type=int, default=8,
+                   help="with --sharded-sweep: shape-bucket the clusters "
+                        "(read-count grid of this size) so heterogeneous "
+                        "inputs compile per bucket instead of padding to "
+                        "the global maxima; 0 = legacy uniform scheduler")
     p.add_argument("--verbose", "-v", type=int, default=0)
     p.add_argument("seq_errors", metavar="seq-errors",
                    help="comma-separated sequence error ratios - "
@@ -243,12 +248,34 @@ def _run_sharded_sweep(infiles: List[str], basenames: List[str], args):
             "device(s), one program",
             file=sys.stderr,
         )
-    results = sweep_clusters_sharded(
+    results, stats = sweep_clusters_sharded(
         clusters, mesh=mesh, max_iters=args.max_iters,
         min_dist=params.min_dist,
         bandwidth_pvalue=params.bandwidth_pvalue,
         cluster_chunk=args.cluster_chunk,
+        scheduler="bucketed" if args.sweep_bucket else "uniform",
+        read_bucket=args.sweep_bucket or 8,
+        do_alignment_proposals=params.do_alignment_proposals,
+        return_stats=True,
     )
+    if args.verbose >= 1:
+        print(
+            f"sharded sweep: {stats.n_buckets} bucket(s), "
+            f"{stats.n_chunks} chunk(s), padding waste "
+            f"{stats.waste:.1%} (uniform layout would pad "
+            f"{stats.uniform_padded_cells / max(stats.padded_cells, 1):.2f}x"
+            f" this), {stats.seconds:.2f}s",
+            file=sys.stderr,
+        )
+        for b in stats.buckets:
+            print(
+                f"  bucket N={b.key[0]} L={b.key[1]} T={b.key[2]} "
+                f"K0={b.key[3]}: {b.n_clusters} cluster(s) in "
+                f"{b.n_chunks} chunk(s) of {b.gp}, occupancy "
+                f"{b.occupancy:.2f}, waste {b.waste:.1%}, "
+                f"{b.seconds:.2f}s",
+                file=sys.stderr,
+            )
     return [
         (name, r.converged, r.consensus)
         for name, r in zip(basenames, results)

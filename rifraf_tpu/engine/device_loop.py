@@ -209,6 +209,24 @@ def _isclose(a, b):
     return jnp.abs(a - b) <= 1e-8 + 1e-5 * jnp.abs(b)
 
 
+def unpack_stage_packed(packed, H: int, Tmax: int):
+    """Host-side view of ONE packed stage-program row (the single-fetch
+    array built at the end of ``run`` below): returns ``(tlen, total,
+    n_rec, completed, resume_old, hlen [H] int64, hist [H, Tmax] int8,
+    tmpl [Tmax] int8)``. The one consumer-side copy of the layout,
+    shared by ``runner`` and parallel.sweep_sharded's per-bucket
+    unpack."""
+    p = np.asarray(packed)
+    o = 5
+    hlen = p[o : o + H].astype(np.int64)
+    o += H
+    hist = p[o : o + H * Tmax].reshape(H, Tmax).astype(np.int8)
+    o += H * Tmax
+    tmpl = p[o : o + Tmax].astype(np.int8)
+    return (int(p[0]), float(p[1]), int(p[2]), bool(p[3]), float(p[4]),
+            hlen, hist, tmpl)
+
+
 def make_stage_runner(
     step_fn: Callable,  # (tmpl, tlen, step_state) -> (total, sub, ins, del)
     do_indels: bool,
@@ -386,17 +404,8 @@ def make_stage_runner(
                 float(prev_score), jnp.int32(iters_left),
                 jnp.int32(prev_iters), step_state)
         )
-        tlen = int(packed[0])
-        total = float(packed[1])
-        n_rec = int(packed[2])
-        completed = bool(packed[3])
-        resume_old = float(packed[4])
-        o = 5
-        hlen = packed[o : o + H].astype(np.int64)
-        o += H
-        hist = packed[o : o + H * Tmax].reshape(H, Tmax).astype(np.int8)
-        o += H * Tmax
-        tmpl = packed[o : o + Tmax].astype(np.int8)
+        (tlen, total, n_rec, completed, resume_old, hlen, hist,
+         tmpl) = unpack_stage_packed(packed, H, Tmax)
         history = [hist[i, : hlen[i]].copy() for i in range(n_rec)]
         return StageResult(
             consensus=tmpl[:tlen],

@@ -31,6 +31,7 @@ from ..ops.banded_array import BandedArray
 from ..ops.proposal_jax import score_proposals_batch
 from ..utils.debug import myassert
 from ..utils.mathops import poisson_cquantile
+from ..utils.shapes import bucket as _bucket
 from ..utils.timers import Timers
 from .params import resolve_dtype, validate_backend
 from .proposals import Proposal
@@ -87,10 +88,6 @@ def _default_hbm_budget() -> float:
     except Exception:
         pass
     return 12e9
-
-
-def _bucket(n: int, b: int) -> int:
-    return ((n + b - 1) // b) * b
 
 
 def _pick_read_chunk(n: int, K: int, T1: int, budget: float) -> int:
@@ -1182,10 +1179,9 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
     mismatch, ins, dels), lengths, bandwidths, weights), rt_arrays[,
     skewed rt_arrays])."""
     from ..ops.align_jax import BandGeometry
-    from ..ops.fused import fused_step_full, pack_layout
+    from ..ops.fused import fused_step_full, unpack_tables
     from .device_loop import make_stage_runner
 
-    lay = pack_layout(n_reads, T1, False)
     ref_tables = _frame_ref_tables(Tmax, Kc, T1pc, nrows, do_cins, do_cdel)
 
     def step_fn(tmpl, tlen, s):
@@ -1200,11 +1196,8 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
             tmpl[:Tmax], seq, match, mismatch, ins, dels, geom, weights,
             K, False, False, chunk,
         )
-        sub_t = packed[slice(*lay["sub"])].reshape(T1, 4)
-        ins_t = packed[slice(*lay["ins"])].reshape(T1, 4)
-        del_t = packed[slice(*lay["del"])]
         base = _add_ref_tables(
-            (packed[0], sub_t, ins_t, del_t),
+            unpack_tables(packed, n_reads, T1),
             ref_tables(tmpl, tlen, rt), Tmax,
         )
         if seed_gate:
@@ -1254,10 +1247,8 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
     backend / f64 exactness runs). step_state = ((seq, match, mismatch,
     ins, dels), lengths, bandwidths, weights)."""
     from ..ops.align_jax import BandGeometry
-    from ..ops.fused import fused_step_full, pack_layout
+    from ..ops.fused import fused_step_full, unpack_tables
     from .device_loop import make_stage_runner
-
-    lay = pack_layout(n_reads, T1, use_edits)
 
     def step_fn(tmpl, tlen, s):
         (seq, match, mismatch, ins, dels), lengths, bw, weights = s
@@ -1266,13 +1257,7 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
             tmpl[:Tmax], seq, match, mismatch, ins, dels, geom, weights,
             K, False, use_edits, chunk,
         )
-        sub_t = packed[slice(*lay["sub"])].reshape(T1, 4)
-        ins_t = packed[slice(*lay["ins"])].reshape(T1, 4)
-        del_t = packed[slice(*lay["del"])]
-        base = (packed[0], sub_t, ins_t, del_t)
-        if use_edits:
-            return base + (packed[slice(*lay["edits"])].reshape(T1, 9),)
-        return base
+        return unpack_tables(packed, n_reads, T1, use_edits)
 
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,

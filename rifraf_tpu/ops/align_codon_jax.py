@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.constants import CODON_LENGTH
+from ..utils.shapes import bucket as _bucket
 from .align_np import (
     TRACE_CODON_DELETE,
     TRACE_CODON_INSERT,
@@ -720,10 +721,6 @@ def _score_proposals_codon(
 # per-column dispatch overheads beat it only at scale)
 DEVICE_THRESHOLD = 512
 _LEN_BUCKET = 256
-
-
-def _bucket(n: int, b: int) -> int:
-    return ((n + b - 1) // b) * b
 
 
 class CodonDeviceAligner:

@@ -37,6 +37,20 @@ from .proposal_dense import (
 DENSE_BLOCK_THRESHOLD = 2048
 
 
+@jax.custom_batching.custom_vmap
+def _fill_barrier(ab):
+    return jax.lax.optimization_barrier(ab)
+
+
+@_fill_barrier.def_vmap
+def _fill_barrier_vmap(axis_size, in_batched, ab):
+    # optimization_barrier is identity with no batching rule in this JAX
+    # version; the barrier applies unchanged to the batched operands, so
+    # a cluster-vmapped step (parallel.sweep_sharded) keeps the same
+    # fill/dense scheduling fence as the unbatched one
+    return jax.lax.optimization_barrier(ab), in_batched[0]
+
+
 def _fused_parts(
     template, seq, match, mismatch, ins, dels, geom, weights, K,
     want_moves, want_stats, want_tables=True,
@@ -57,7 +71,7 @@ def _fused_parts(
     A, moves, scores, B = fwd_bwd(
         template, seq, match, mismatch, ins, dels, geom, K, need_moves
     )
-    A, B = jax.lax.optimization_barrier((A, B))
+    A, B = _fill_barrier((A, B))
 
     T1 = template.shape[0] + 1
     if not want_tables:
@@ -233,15 +247,29 @@ def pack_layout(n_reads: int, T1: int, want_stats: bool,
     return out
 
 
+def unpack_tables(packed, n_reads: int, T1: int, want_stats: bool = False):
+    """Score-table view of the packed array (host- or trace-side):
+    ``(total, sub [T1, 4], ins [T1, 4], del [T1])``, plus the union
+    edit-indicator table ``edits [T1, 9]`` when ``want_stats``. The one
+    consumer-side copy of the slicing every step engine shares
+    (engine.realign's stage runners, parallel.sweep_sharded's per-bucket
+    programs)."""
+    lay = pack_layout(n_reads, T1, want_stats)
+    sub = packed[slice(*lay["sub"])].reshape(T1, 4)
+    insr = packed[slice(*lay["ins"])].reshape(T1, 4)
+    dele = packed[slice(*lay["del"])]
+    out = (packed[0], sub, insr, dele)
+    if want_stats:
+        out = out + (packed[slice(*lay["edits"])].reshape(T1, 9),)
+    return out
+
+
 def fused_step(template, seq, match, mismatch, ins, dels, geom, weights, K):
     """Score-table view of the fused step: (sub, ins, del, total)."""
     _, _, _, packed = fused_step_full(
         template, seq, match, mismatch, ins, dels, geom, weights, K
     )
-    N = seq.shape[0]
-    T1 = template.shape[0] + 1
-    lay = pack_layout(N, T1, False)
-    sub = packed[slice(*lay["sub"])].reshape(T1, 4)
-    insr = packed[slice(*lay["ins"])].reshape(T1, 4)
-    dele = packed[slice(*lay["del"])]
-    return sub, insr, dele, packed[0]
+    total, sub, insr, dele = unpack_tables(
+        packed, seq.shape[0], template.shape[0] + 1
+    )
+    return sub, insr, dele, total

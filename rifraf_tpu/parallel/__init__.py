@@ -1,4 +1,4 @@
-from .cluster import resolve_jobs_flag, sweep_clusters
+from .cluster import pipeline_map, resolve_jobs_flag, sweep_clusters
 from .sharding import (
     READS_AXIS,
     make_mesh,
@@ -6,4 +6,11 @@ from .sharding import (
     shard_batch,
     sharded_consensus_step,
 )
-from .sweep_sharded import SweepResult, sweep_clusters_sharded
+from .sweep_sharded import (
+    BucketPlan,
+    BucketStats,
+    SweepResult,
+    SweepStats,
+    plan_sweep,
+    sweep_clusters_sharded,
+)

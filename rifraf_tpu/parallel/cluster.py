@@ -106,6 +106,48 @@ def sweep_clusters(
             raise
 
 
+def pipeline_map(
+    pack_fn: Callable[[T], object],
+    run_fn: Callable[[object], object],
+    collect_fn: Callable[[object], R],
+    items: Sequence[T],
+) -> List[R]:
+    """Two-deep host/device software pipeline over ``items``.
+
+    For each item: ``pack_fn`` (host-side work — NumPy packing, padding)
+    runs on a single background thread, ``run_fn`` (device dispatch —
+    must NOT block on results, JAX dispatch is asynchronous) and
+    ``collect_fn`` (the blocking fetch, e.g. ``np.asarray``) run on the
+    calling thread. The schedule overlaps item k+1's packing with item
+    k's device execution, and defers item k's collect until AFTER item
+    k+1 has been dispatched — so the device queue is never drained by a
+    host-side fetch while more work is available:
+
+        pack[0] dispatch[0] | pack[1] dispatch[1] collect[0] | ...
+
+    One background thread (not a pool): packing is NumPy-bound and the
+    pipeline only ever needs the next item early. Results come back in
+    item order. Exceptions from any stage propagate to the caller.
+    """
+    items = list(items)
+    if not items:
+        return []
+    out: List[R] = []
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        nxt = pool.submit(pack_fn, items[0])
+        pending = None  # device handle for the previous item
+        for i in range(len(items)):
+            packed = nxt.result()
+            if i + 1 < len(items):
+                nxt = pool.submit(pack_fn, items[i + 1])
+            handle = run_fn(packed)
+            if pending is not None:
+                out.append(collect_fn(pending))
+            pending = handle
+        out.append(collect_fn(pending))
+    return out
+
+
 def resolve_jobs_flag(jobs_flag: int, n_files: int) -> int:
     """CLI --jobs semantics: 0 = auto (one worker per device), else the
     explicit count capped by the number of files."""

@@ -11,26 +11,61 @@ collectives needed; the axis is embarrassingly parallel), so a pod
 slice processes thousands of clusters with one dispatch per
 adaptation round plus one per stage sweep.
 
+Scheduling (the ``scheduler="bucketed"`` default): clusters are grouped
+into SHAPE BUCKETS keyed ``(Npad, Lpad, Tmax, K0)`` on a fixed grid —
+read count to ``read_bucket`` multiples, read length and template
+columns to ``len_bucket`` multiples, band height to ``band_bucket``
+multiples — so each bucket signature compiles ONCE (module-level
+lru-cached program factories, the pattern of engine.realign's
+``_xla_stage_runner``) and the executable is reused across chunks and
+across calls. Real read sets are heterogeneous (amplicon sweeps mix
+200 bp and 3 kb clusters); padding everything to the global maxima
+burns device cells on padding — the per-bucket padded/useful cell
+accounting comes back in ``SweepStats``. ``scheduler="uniform"`` keeps
+the legacy everything-to-global-maxima layout (one bucket, band grid 8,
+raw read-count padding), with chunk shapes pinned to the GLOBAL grid so
+chunked calls no longer recompile per chunk.
+
+Chunks are double-buffered through ``parallel.cluster.pipeline_map``:
+host packing of chunk k+1 (NumPy batch building, Poisson thresholds)
+overlaps device execution of chunk k via JAX async dispatch, and chunk
+k's blocking fetch happens only after chunk k+1 has been dispatched.
+On non-CPU backends the stage program donates its read-batch buffers
+(``donate_argnums``) so each bucket's HBM is recycled as soon as its
+stage finishes.
+
 Scope: the device-loop configuration (engine.device_loop) — no
-reference, full batch per cluster, all-edits candidates
-(do_alignment_proposals=False). Per-cluster results are BIT-IDENTICAL
-to running `rifraf()` per cluster in that configuration
+reference, full batch per cluster; candidates from the all-edits tables,
+optionally masked by the in-kernel alignment-edits gate
+(``do_alignment_proposals=True``). Reference-guided and FRAME-stage runs
+still go through the host driver. Per-cluster results are BIT-IDENTICAL
+to running `rifraf()` per cluster in the matching configuration
 (tests/test_sweep_sharded.py): the same fused XLA step, the same
 candidate selection, the same adaptive-bandwidth protocol, just with a
 leading cluster axis everywhere (lax.while_loop under vmap keeps
-finished clusters frozen while stragglers iterate).
+finished clusters frozen while stragglers iterate). Bucketing cannot
+perturb results: band-height padding is masked by the band geometry,
+and weight-0 pad reads/clusters drop out of every reduction.
 """
 
 from __future__ import annotations
 
-from typing import List, NamedTuple, Sequence
+import functools
+import time
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..models.sequences import ReadScores, batch_reads
 from ..utils.mathops import logsumexp10, poisson_cquantile
+from ..utils.shapes import bucket as _bucket
+from .cluster import pipeline_map
 
 MAX_BANDWIDTH_DOUBLINGS = 5  # model.jl:650
+
+# bucketed-scheduler grid defaults: read-count and band-height rounding
+READ_BUCKET = 8
+BAND_BUCKET = 16
 
 
 class SweepResult(NamedTuple):
@@ -40,8 +75,216 @@ class SweepResult(NamedTuple):
     converged: bool
 
 
-def _bucket(n: int, b: int) -> int:
-    return ((n + b - 1) // b) * b
+class BucketStats(NamedTuple):
+    """Per-bucket report: one entry per compiled shape signature."""
+
+    key: Tuple[int, int, int, int]  # (Npad, Lpad, Tmax, K0)
+    n_clusters: int
+    n_chunks: int
+    gp: int  # pinned cluster-axis size of every chunk
+    occupancy: float  # real clusters / (n_chunks * gp)
+    useful_cells: int  # sum of real read lengths
+    padded_cells: int  # n_chunks * gp * Npad * Lpad
+    waste: float  # 1 - useful/padded
+    seconds: float  # main-thread dispatch+fetch time (approximate
+    #   under pipelining: packing overlaps other buckets' device work)
+
+
+class SweepStats(NamedTuple):
+    n_clusters: int
+    n_buckets: int
+    n_chunks: int
+    useful_cells: int
+    padded_cells: int
+    waste: float
+    # cells the legacy uniform layout would have allocated for the same
+    # inputs — padded_cells/uniform_padded_cells is the bucketing win
+    uniform_padded_cells: int
+    seconds: float  # wall time of the whole sweep
+    buckets: List[BucketStats]
+
+
+class BucketPlan(NamedTuple):
+    """One shape bucket: which input clusters it holds and how they are
+    chunked along the (pinned) cluster axis."""
+
+    key: Tuple[int, int, int, int]  # (Npad, Lpad, Tmax, K0)
+    band: int  # band-height grid for this bucket's K choices
+    gp: int  # cluster-axis size every chunk is padded to
+    chunks: List[List[int]]  # input indices per chunk, input order
+
+
+class _ClusterInfo(NamedTuple):
+    n_reads: int
+    max_len: int
+    seed_idx: int  # read index of the initial consensus
+    tlen0: int  # its length
+    entry_k: int  # band height demand at entry bandwidths
+    useful: int  # sum of read lengths
+
+
+def _cluster_infos(
+    clusters: Sequence[Sequence[ReadScores]],
+) -> List[_ClusterInfo]:
+    """Host-side per-cluster facts the planner and packer share. The
+    seed is the read with the best logsumexp10(match_scores)
+    (model.jl:575-579) — computed once here, reused by packing."""
+    infos = []
+    for c in clusters:
+        k = int(np.argmax([logsumexp10(r.match_scores) for r in c]))
+        tlen0 = len(c[k])
+        infos.append(_ClusterInfo(
+            n_reads=len(c),
+            max_len=max(len(r) for r in c),
+            seed_idx=k,
+            tlen0=tlen0,
+            entry_k=max(
+                2 * r.bandwidth + abs(len(r) - tlen0) + 1 for r in c
+            ),
+            useful=sum(len(r) for r in c),
+        ))
+    return infos
+
+
+def plan_sweep(
+    clusters: Sequence[Sequence[ReadScores]],
+    scheduler: str = "bucketed",
+    read_bucket: int = READ_BUCKET,
+    band_bucket: int = BAND_BUCKET,
+    len_bucket: int = 64,
+    cluster_chunk: int = 0,
+    n_axis: int = 1,
+    infos: Optional[List[_ClusterInfo]] = None,
+) -> List[BucketPlan]:
+    """Group clusters into shape buckets and chunk each bucket's cluster
+    axis. Pure host arithmetic — no JAX — so planner invariants are
+    cheaply testable.
+
+    ``bucketed``: per-cluster key = (reads to ``read_bucket``, max read
+    length to ``len_bucket``, seed length + 2 to ``len_bucket``, entry
+    band demand to ``band_bucket``). ``uniform``: ONE bucket at the
+    global maxima (raw read count, band grid 8) — the legacy layout.
+    Either way every chunk of a bucket is padded to the same ``gp``
+    (``cluster_chunk`` rounded up to the cluster grid), so chunked calls
+    reuse one executable instead of recompiling per chunk.
+    """
+    if scheduler not in ("bucketed", "uniform"):
+        raise ValueError(f"unknown sweep scheduler: {scheduler!r}")
+    if infos is None:
+        infos = _cluster_infos(clusters)
+    if not infos:
+        return []
+
+    if scheduler == "uniform":
+        band = 8
+        grid = max(n_axis, 1)
+        key = (
+            max(i.n_reads for i in infos),
+            _bucket(max(i.max_len for i in infos), len_bucket),
+            _bucket(max(i.tlen0 for i in infos) + 2, len_bucket),
+            _bucket(max(i.entry_k for i in infos), band),
+        )
+        groups = {key: list(range(len(infos)))}
+    else:
+        band = band_bucket
+        # the cluster axis only rounds to the mesh axis (so every chunk
+        # shards evenly) — no larger minimum: padding a one-cluster
+        # bucket to a fixed grid can cost more cells than the uniform
+        # layout it is supposed to beat
+        grid = max(n_axis, 1)
+        groups = {}
+        for i, info in enumerate(infos):
+            key = (
+                _bucket(info.n_reads, read_bucket),
+                _bucket(info.max_len, len_bucket),
+                _bucket(info.tlen0 + 2, len_bucket),
+                _bucket(info.entry_k, band),
+            )
+            groups.setdefault(key, []).append(i)
+
+    plans = []
+    for key, members in groups.items():
+        target = min(len(members), cluster_chunk) if cluster_chunk else (
+            len(members)
+        )
+        gp = _bucket(max(target, 1), grid)
+        chunks = [members[s : s + gp] for s in range(0, len(members), gp)]
+        plans.append(BucketPlan(key=key, band=band, gp=gp, chunks=chunks))
+    return plans
+
+
+def plan_cells(plans: Sequence[BucketPlan]) -> int:
+    """Total padded device cells (read-lane cells, the [G, N, L] batch
+    footprint) a plan allocates."""
+    return sum(
+        len(p.chunks) * p.gp * p.key[0] * p.key[1] for p in plans
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _adapt_program(Tmax: int, K: int):
+    """One adaptive-bandwidth round for a whole chunk: vmapped fill +
+    traceback statistics, n_errors [G, N] out. Module-level cache so
+    repeated sweep calls reuse the jitted wrapper (a fresh jax.jit per
+    call would recompile every round of every call)."""
+    import jax
+
+    from ..ops import align_jax
+    from ..ops.fused import fused_step_full, pack_layout
+
+    def one(seq_g, match_g, mismatch_g, ins_g, dels_g, lengths_g, bw_g,
+            w_g, tmpl_g, tlen_g):
+        geom = align_jax.BandGeometry.make(lengths_g, tlen_g, bw_g)
+        _, _, _, packed = fused_step_full(
+            tmpl_g[:Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g,
+            geom, w_g, K, False, True, 0, False,
+        )
+        lay = pack_layout(seq_g.shape[0], Tmax + 1, True, False)
+        return packed[slice(*lay["n_errors"])]
+
+    return jax.jit(jax.vmap(one))
+
+
+@functools.lru_cache(maxsize=None)
+def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
+                   use_edits: bool, donate: bool):
+    """The whole INIT stage for a chunk, vmapped over the cluster axis.
+    One cached program per (Tmax, K, H, min_dist, gate) signature; XLA's
+    jit cache then keys on the batch avals, so every chunk of a bucket
+    (and every later call with the same bucket) reuses one executable.
+    ``donate`` hands the read-batch buffers to XLA (non-CPU backends) so
+    a finished bucket's HBM is recycled for the next one."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.device_loop import make_stage_runner
+    from ..ops import align_jax
+    from ..ops.fused import fused_step_full, unpack_tables
+
+    def step_fn(tmpl, tlen, s):
+        (seq_g, match_g, mismatch_g, ins_g, dels_g), lengths_g, bw_g, \
+            w_g = s
+        geom = align_jax.BandGeometry.make(lengths_g, tlen, bw_g)
+        _, _, _, packed = fused_step_full(
+            tmpl[:Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g, geom,
+            w_g, K, False, use_edits, 0,
+        )
+        return unpack_tables(packed, seq_g.shape[0], Tmax + 1, use_edits)
+
+    runner = make_stage_runner(
+        step_fn, do_indels=True, min_dist=min_dist, H=H, Tmax=Tmax,
+        stop_on_same=True, gate="edits" if use_edits else "none",
+    )
+
+    def call(t0, tl, step_state):
+        return jax.vmap(
+            lambda a, b, s: runner.run(
+                a, b, -jnp.inf, jnp.int32(H - 1), jnp.int32(0), s
+            ),
+            in_axes=(0, 0, ((0, 0, 0, 0, 0), 0, 0, 0)),
+        )(t0, tl, step_state)
+
+    return jax.jit(call, donate_argnums=(2,) if donate else ())
 
 
 def sweep_clusters_sharded(
@@ -52,93 +295,53 @@ def sweep_clusters_sharded(
     bandwidth_pvalue: float = 0.1,
     len_bucket: int = 64,
     cluster_chunk: int = 0,
-) -> List[SweepResult]:
+    scheduler: str = "bucketed",
+    read_bucket: int = READ_BUCKET,
+    band_bucket: int = BAND_BUCKET,
+    do_alignment_proposals: bool = False,
+    return_stats: bool = False,
+):
     """One consensus per cluster, all clusters in one device program.
 
     ``clusters``: per-cluster ReadScores lists (build with
     make_read_scores). ``mesh``: optional Mesh whose FIRST axis shards
     the cluster dimension; None runs unsharded on the default device.
     ``cluster_chunk`` > 0 processes the cluster axis in sequential
-    chunks of that size (bands for every in-flight cluster live in HBM
-    simultaneously — a 1024-cluster batch can exceed one chip).
+    chunks of (up to) that size (bands for every in-flight cluster live
+    in HBM simultaneously — a 1024-cluster batch can exceed one chip);
+    the effective chunk size rounds up to the cluster grid so all
+    chunks share one shape. ``scheduler``/``read_bucket``/
+    ``band_bucket``: see plan_sweep. ``do_alignment_proposals`` enables
+    the in-kernel alignment-edits candidate gate (the driver default),
+    matching ``rifraf(..., do_alignment_proposals=True)``.
+
+    Returns the per-cluster results IN INPUT ORDER; with
+    ``return_stats`` also a SweepStats (per-bucket occupancy, padding
+    waste, and timing).
     """
-    if cluster_chunk and len(clusters) > cluster_chunk:
-        out: List[SweepResult] = []
-        for s in range(0, len(clusters), cluster_chunk):
-            out.extend(sweep_clusters_sharded(
-                clusters[s : s + cluster_chunk], mesh=mesh,
-                max_iters=max_iters, min_dist=min_dist,
-                bandwidth_pvalue=bandwidth_pvalue, len_bucket=len_bucket,
-            ))
-        return out
+    t_start = time.perf_counter()
+    G = len(clusters)
+    infos = _cluster_infos(clusters)
+    n_axis = mesh.devices.size if mesh is not None else 1
+    plans = plan_sweep(
+        clusters, scheduler=scheduler, read_bucket=read_bucket,
+        band_bucket=band_bucket, len_bucket=len_bucket,
+        cluster_chunk=cluster_chunk, n_axis=n_axis, infos=infos,
+    )
+    if G == 0:
+        stats = SweepStats(0, 0, 0, 0, 0, 0.0, 0, 0.0, [])
+        return ([], stats) if return_stats else []
+
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from ..engine.device_loop import make_stage_runner
-    from ..ops import align_jax
-    from ..ops.fused import fused_step_full, pack_layout
-
+    from ..engine.device_loop import MAX_DRIFT, unpack_stage_packed
     from ..engine.params import resolve_dtype
 
     dtype = resolve_dtype(None)
-    G = len(clusters)
-    if G == 0:
-        return []
-    n_axis = mesh.devices.size if mesh is not None else 1
-    Gp = _bucket(G, max(n_axis, 1))
-    N = max(len(c) for c in clusters)
-    L = _bucket(max(len(r) for c in clusters for r in c), len_bucket)
-
-    # pad every cluster to [N] reads (repeating the first read at weight
-    # 0 keeps shapes without changing geometry bounds) and every read to
-    # [L]; clusters beyond G repeat cluster 0 at weight 0 everywhere
-    seqs = np.zeros((Gp, N, L), np.int8)
-    match = np.zeros((Gp, N, L), dtype)
-    mismatch = np.zeros((Gp, N, L), dtype)
-    ins = np.zeros((Gp, N, L), dtype)
-    dels = np.zeros((Gp, N, L + 1), dtype)
-    lengths = np.zeros((Gp, N), np.int32)
-    weights = np.zeros((Gp, N), dtype)
-    bandwidths = np.zeros((Gp, N), np.int32)
-    est_err = np.zeros((Gp, N), np.float64)
-
-    for g in range(Gp):
-        c = clusters[g] if g < G else clusters[0]
-        live = len(c) if g < G else 0
-        b = batch_reads(list(c) + [c[0]] * (N - len(c)), max_len=L,
-                        dtype=dtype)
-        seqs[g], match[g], mismatch[g] = b.seq, b.match, b.mismatch
-        ins[g], dels[g], lengths[g] = b.ins, b.dels, b.lengths
-        weights[g, :live] = 1.0
-        bandwidths[g] = [r.bandwidth for r in c] + [c[0].bandwidth] * (
-            N - len(c)
-        )
-        est_err[g] = [r.est_n_errors for r in c] + [c[0].est_n_errors] * (
-            N - len(c)
-        )
-
-    # initial consensus per cluster: the read with the best
-    # logsumexp10(match_scores) (model.jl:575-579)
-    tlens0 = np.zeros(Gp, np.int32)
-    Tmax = 0
-    best_idx = np.zeros(Gp, np.int64)
-    for g in range(Gp):
-        c = clusters[g] if g < G else clusters[0]
-        k = int(np.argmax([logsumexp10(r.match_scores) for r in c]))
-        best_idx[g] = k
-        tlens0[g] = len(c[k])
-        Tmax = max(Tmax, len(c[k]) + 1)
-    Tmax = _bucket(Tmax + 1, len_bucket)
-    tmpl0 = np.zeros((Gp, Tmax), np.int8)
-    for g in range(Gp):
-        c = clusters[g] if g < G else clusters[0]
-        r = c[int(best_idx[g])]
-        tmpl0[g, : len(r)] = r.seq
-
-    from ..engine.device_loop import MAX_DRIFT
-
-    T1 = Tmax + 1
+    H = max_iters + 1
+    donate = jax.default_backend() != "cpu"
     shard = (
         (lambda a, *spec: jax.device_put(
             a, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], *spec))
@@ -147,106 +350,187 @@ def sweep_clusters_sharded(
         else (lambda a, *spec: jnp.asarray(a))
     )
 
-    def shard_all(bw):
-        return (
-            shard(seqs, None, None), shard(match, None, None),
-            shard(mismatch, None, None), shard(ins, None, None),
-            shard(dels, None, None), shard(lengths, None),
-            shard(bw, None), shard(weights, None),
-        )
+    tasks = [
+        (bi, plan, chunk)
+        for bi, plan in enumerate(plans)
+        for chunk in plan.chunks
+    ]
 
-    # ---- adaptive bandwidth (smart_forward_moves!, model.jl:643-672),
-    # all clusters per round in ONE vmapped dispatch ----
-    def adapt_round_fn(K):
-        def one(seq_g, match_g, mismatch_g, ins_g, dels_g, lengths_g,
-                bw_g, w_g, tmpl_g, tlen_g):
-            geom = align_jax.BandGeometry.make(lengths_g, tlen_g, bw_g)
-            _, _, _, packed = fused_step_full(
-                tmpl_g[: Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g,
-                geom, w_g, K, False, True, 0, False,
+    def pack(task):
+        """Host side of one chunk: batch, pad, and threshold — runs on
+        the pipeline's background thread while the previous chunk
+        executes on device."""
+        bi, plan, idxs = task
+        N, L, Tmax, _ = plan.key
+        Gp = plan.gp
+        seqs = np.zeros((Gp, N, L), np.int8)
+        match = np.zeros((Gp, N, L), dtype)
+        mismatch = np.zeros((Gp, N, L), dtype)
+        ins = np.zeros((Gp, N, L), dtype)
+        dels = np.zeros((Gp, N, L + 1), dtype)
+        lengths = np.zeros((Gp, N), np.int32)
+        weights = np.zeros((Gp, N), dtype)
+        bandwidths = np.zeros((Gp, N), np.int32)
+        est_err = np.zeros((Gp, N), np.float64)
+        tlens0 = np.zeros(Gp, np.int32)
+        tmpl0 = np.zeros((Gp, Tmax), np.int8)
+
+        # pad every cluster to [N] reads (repeating the first read at
+        # weight 0 keeps shapes without changing geometry bounds) and
+        # every read to [L]; cluster slots beyond the chunk repeat the
+        # chunk's first cluster at weight 0 everywhere
+        for g in range(Gp):
+            ci = idxs[g] if g < len(idxs) else idxs[0]
+            c, info = clusters[ci], infos[ci]
+            live = len(c) if g < len(idxs) else 0
+            b = batch_reads(list(c) + [c[0]] * (N - len(c)), max_len=L,
+                            dtype=dtype)
+            seqs[g], match[g], mismatch[g] = b.seq, b.match, b.mismatch
+            ins[g], dels[g], lengths[g] = b.ins, b.dels, b.lengths
+            weights[g, :live] = 1.0
+            bandwidths[g] = [r.bandwidth for r in c] + [
+                c[0].bandwidth
+            ] * (N - len(c))
+            est_err[g] = [r.est_n_errors for r in c] + [
+                c[0].est_n_errors
+            ] * (N - len(c))
+            tlens0[g] = info.tlen0
+            seed = c[info.seed_idx]
+            tmpl0[g, : len(seed)] = seed.seq
+        thresholds = np.array([
+            [poisson_cquantile(est_err[g, k], bandwidth_pvalue)
+             for k in range(N)] for g in range(Gp)
+        ])
+        return {
+            "task": task, "seqs": seqs, "match": match,
+            "mismatch": mismatch, "ins": ins, "dels": dels,
+            "lengths": lengths, "weights": weights,
+            "bandwidths": bandwidths, "est_err": est_err,
+            "thresholds": thresholds, "tlens0": tlens0, "tmpl0": tmpl0,
+        }
+
+    bucket_seconds = [0.0] * len(plans)
+
+    def run(p):
+        """Device side of one chunk: adaptive-bandwidth rounds (each a
+        blocking fetch of n_errors), then ONE async stage dispatch —
+        returns the un-fetched packed handle so the next chunk can pack
+        and dispatch before we block on it."""
+        t0 = time.perf_counter()
+        bi, plan, idxs = p["task"]
+        _, _, Tmax, _ = plan.key
+        lengths, weights = p["lengths"], p["weights"]
+        bandwidths, tlens0 = p["bandwidths"], p["tlens0"]
+
+        # the big read batch transfers ONCE; only the bandwidths column
+        # re-uploads per adaptation round
+        sq_d = shard(p["seqs"], None, None)
+        mt_d = shard(p["match"], None, None)
+        mm_d = shard(p["mismatch"], None, None)
+        gi_d = shard(p["ins"], None, None)
+        dl_d = shard(p["dels"], None, None)
+        ln_d = shard(lengths, None)
+        w_d = shard(weights, None)
+        t0_d = shard(p["tmpl0"], None)
+        tl_d = jnp.asarray(tlens0)
+
+        # ---- adaptive bandwidth (smart_forward_moves!,
+        # model.jl:643-672), all the chunk's clusters per round in ONE
+        # vmapped dispatch ----
+        entry_bw = bandwidths.copy()
+        fixed = np.zeros_like(weights, bool)
+        fixed[weights == 0] = True
+        old_errors = np.full(lengths.shape, np.iinfo(np.int64).max)
+        for _ in range(MAX_BANDWIDTH_DOUBLINGS + 1):
+            K = _bucket(
+                int((2 * bandwidths + np.abs(lengths - tlens0[:, None])
+                     + 1).max()),
+                plan.band,
             )
-            lay = pack_layout(N, T1, True, False)
-            return packed[slice(*lay["n_errors"])]
+            n_err = np.asarray(_adapt_program(Tmax, K)(
+                sq_d, mt_d, mm_d, gi_d, dl_d, ln_d,
+                shard(bandwidths, None), w_d, t0_d, tl_d,
+            )).astype(np.int64)
+            max_bw = np.minimum(
+                np.minimum(entry_bw << MAX_BANDWIDTH_DOUBLINGS,
+                           tlens0[:, None]),
+                lengths,
+            )
+            grow = (~fixed) & (n_err > p["thresholds"]) & (
+                n_err < old_errors
+            ) & (bandwidths < max_bw)
+            fixed |= ~grow
+            if not grow.any():
+                break
+            old_errors = np.where(grow, n_err, old_errors)
+            bandwidths = np.where(
+                grow, np.minimum(bandwidths * 2, max_bw), bandwidths
+            )
 
-        return jax.jit(jax.vmap(one))
-
-    entry_bw = bandwidths.copy()
-    fixed = np.zeros((Gp, N), bool)
-    fixed[weights == 0] = True
-    old_errors = np.full((Gp, N), np.iinfo(np.int64).max)
-    thresholds = np.array([
-        [poisson_cquantile(est_err[g, k], bandwidth_pvalue)
-         for k in range(N)] for g in range(Gp)
-    ])
-    for _ in range(MAX_BANDWIDTH_DOUBLINGS + 1):
-        K = int(
-            (2 * bandwidths + np.abs(lengths - tlens0[:, None]) + 1).max()
+        # ---- the whole INIT stage, vmapped over clusters: dispatch
+        # only; the fetch is deferred to collect() ----
+        K = _bucket(
+            int((2 * bandwidths + np.abs(lengths - tlens0[:, None])
+                 + 1).max()) + MAX_DRIFT,
+            plan.band,
         )
-        K = _bucket(K, 8)
-        n_err = np.asarray(adapt_round_fn(K)(
-            *shard_all(bandwidths), shard(tmpl0, None),
-            jnp.asarray(tlens0),
-        )).astype(np.int64)
-        max_bw = np.minimum(
-            np.minimum(entry_bw << MAX_BANDWIDTH_DOUBLINGS,
-                       tlens0[:, None]),
-            lengths,
+        step_state = (
+            (sq_d, mt_d, mm_d, gi_d, dl_d), ln_d,
+            shard(bandwidths, None), w_d,
         )
-        grow = (~fixed) & (n_err > thresholds) & (n_err < old_errors) & (
-            bandwidths < max_bw
-        )
-        fixed |= ~grow
-        if not grow.any():
-            break
-        old_errors = np.where(grow, n_err, old_errors)
-        bandwidths = np.where(grow, np.minimum(bandwidths * 2, max_bw),
-                              bandwidths)
+        packed = _stage_program(
+            Tmax, K, H, min_dist, do_alignment_proposals, donate
+        )(t0_d, tl_d, step_state)
+        bucket_seconds[bi] += time.perf_counter() - t0
+        return packed, p["task"]
 
-    # ---- the whole INIT stage, vmapped over clusters ----
-    K = _bucket(
-        int((2 * bandwidths + np.abs(lengths - tlens0[:, None]) + 1).max())
-        + MAX_DRIFT,
-        8,
-    )
-    lay = pack_layout(N, T1, False)
+    out: List[Optional[SweepResult]] = [None] * G
 
-    def step_fn(tmpl, tlen, s):
-        (seq_g, match_g, mismatch_g, ins_g, dels_g), lengths_g, bw_g, w_g = s
-        geom = align_jax.BandGeometry.make(lengths_g, tlen, bw_g)
-        _, _, _, packed = fused_step_full(
-            tmpl[:Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g, geom,
-            w_g, K, False, False, 0,
-        )
-        sub_t = packed[slice(*lay["sub"])].reshape(T1, 4)
-        ins_t = packed[slice(*lay["ins"])].reshape(T1, 4)
-        del_t = packed[slice(*lay["del"])]
-        return packed[0], sub_t, ins_t, del_t
+    def collect(handle):
+        packed_dev, (bi, plan, idxs) = handle
+        t0 = time.perf_counter()
+        packed = np.asarray(packed_dev)
+        Tmax = plan.key[2]
+        for g, ci in enumerate(idxs):
+            tlen, total, n_rec, completed, _, _, _, tmpl = (
+                unpack_stage_packed(packed[g], H, Tmax)
+            )
+            out[ci] = SweepResult(
+                consensus=tmpl[:tlen], score=total, n_iters=n_rec,
+                converged=completed,
+            )
+        bucket_seconds[bi] += time.perf_counter() - t0
 
-    runner = make_stage_runner(
-        step_fn, do_indels=True, min_dist=min_dist, H=max_iters + 1,
-        Tmax=Tmax, stop_on_same=True,
-    )
-    sq_d, mt_d, mm_d, gi_d, dl_d, ln_d, bw_d, w_d = shard_all(bandwidths)
-    step_state = ((sq_d, mt_d, mm_d, gi_d, dl_d), ln_d, bw_d, w_d)
+    pipeline_map(pack, run, collect, tasks)
 
-    packed = jax.vmap(
-        lambda t0, tl, st: runner.run(t0, tl, -jnp.inf, jnp.int32(max_iters),
-                                      jnp.int32(0), st),
-        in_axes=(0, 0, ((0, 0, 0, 0, 0), 0, 0, 0)),
-    )(shard(tmpl0, None), jnp.asarray(tlens0), step_state)
-    packed = np.asarray(packed)
+    if not return_stats:
+        return list(out)
 
-    H = max_iters + 1
-    out = []
-    for g in range(G):
-        p = packed[g]
-        tlen = int(p[0])
-        total = float(p[1])
-        n_rec = int(p[2])
-        completed = bool(p[3])
-        o = 5 + H + H * Tmax
-        cons = p[o : o + Tmax].astype(np.int8)[:tlen]
-        out.append(SweepResult(
-            consensus=cons, score=total, n_iters=n_rec, converged=completed,
+    useful_total = sum(i.useful for i in infos)
+    buckets = []
+    for bi, plan in enumerate(plans):
+        n_in = sum(len(ch) for ch in plan.chunks)
+        padded = len(plan.chunks) * plan.gp * plan.key[0] * plan.key[1]
+        useful = sum(infos[ci].useful for ch in plan.chunks for ci in ch)
+        buckets.append(BucketStats(
+            key=plan.key, n_clusters=n_in, n_chunks=len(plan.chunks),
+            gp=plan.gp,
+            occupancy=n_in / (len(plan.chunks) * plan.gp),
+            useful_cells=useful, padded_cells=padded,
+            waste=1.0 - useful / padded,
+            seconds=bucket_seconds[bi],
         ))
-    return out
+    padded_total = plan_cells(plans)
+    uniform_plans = plan_sweep(
+        clusters, scheduler="uniform", len_bucket=len_bucket,
+        cluster_chunk=cluster_chunk, n_axis=n_axis, infos=infos,
+    )
+    stats = SweepStats(
+        n_clusters=G, n_buckets=len(plans), n_chunks=len(tasks),
+        useful_cells=useful_total, padded_cells=padded_total,
+        waste=1.0 - useful_total / padded_total,
+        uniform_padded_cells=plan_cells(uniform_plans),
+        seconds=time.perf_counter() - t_start,
+        buckets=buckets,
+    )
+    return list(out), stats

@@ -17,6 +17,7 @@ from .phred import (
     normalize,
 )
 from .mathops import logsumexp10, summax
+from .shapes import bucket, pow2_bucket
 
 __all__ = [
     "CODON_LENGTH",
@@ -35,4 +36,6 @@ __all__ = [
     "normalize",
     "logsumexp10",
     "summax",
+    "bucket",
+    "pow2_bucket",
 ]

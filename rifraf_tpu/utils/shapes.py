@@ -1,0 +1,25 @@
+"""Shared shape-grid helpers.
+
+Every device program in this repo is compiled for PADDED shapes drawn
+from a fixed grid — template columns to `len_bucket` multiples, band
+heights to sublane multiples, read lanes to 128 — so the hill-climbing
+loop's changing problem sizes re-use cached XLA executables instead of
+recompiling (engine.realign module docstring). These helpers are the
+single definition of that rounding; engine.realign,
+ops.align_codon_jax, and parallel.sweep_sharded all import them
+(three private copies existed before).
+"""
+
+from __future__ import annotations
+
+
+def bucket(n: int, b: int) -> int:
+    """Round ``n`` up to the next multiple of ``b``."""
+    return ((n + b - 1) // b) * b
+
+
+def pow2_bucket(n: int) -> int:
+    """Round ``n`` up to the next power of two (>= 1). Used for axes
+    whose exact size varies freely (e.g. the cluster axis of a sweep
+    bucket) to cap the number of distinct compiled shapes at log2."""
+    return 1 << max(n - 1, 0).bit_length()

@@ -1,0 +1,160 @@
+"""Sweep-scheduler planner invariants (parallel.sweep_sharded.plan_sweep)
+and the host/device pipeline helper (parallel.cluster.pipeline_map).
+
+Pure host arithmetic — no device programs are built, so this runs in the
+fast (non-slow) suite.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.parallel.cluster import pipeline_map
+from rifraf_tpu.parallel.sweep_sharded import plan_cells, plan_sweep
+
+
+class _Read:
+    """The minimal read surface the planner touches."""
+
+    def __init__(self, n, bandwidth=10, score=0.0):
+        self.seq = np.zeros(n, np.int8)
+        self.match_scores = np.full(n, score)
+        self.bandwidth = bandwidth
+
+    def __len__(self):
+        return len(self.seq)
+
+
+def _cluster(n_reads, length, bandwidth=10):
+    # read 0 gets the best seed score so tlen0 == length, deterministically
+    return [_Read(length, bandwidth, score=-float(k))
+            for k in range(n_reads)]
+
+
+HET = (
+    [_cluster(4, 50), _cluster(9, 80), _cluster(5, 50), _cluster(8, 81),
+     _cluster(4, 52), _cluster(12, 300), _cluster(3, 49), _cluster(4, 51),
+     _cluster(5, 53), _cluster(4, 48)]
+)
+
+
+def test_plan_partitions_inputs_in_order():
+    """Every input cluster lands in exactly one chunk, and chunks
+    preserve input order within a bucket."""
+    plans = plan_sweep(HET)
+    seen = [i for p in plans for ch in p.chunks for i in ch]
+    assert sorted(seen) == list(range(len(HET)))
+    for p in plans:
+        flat = [i for ch in p.chunks for i in ch]
+        assert flat == sorted(flat)
+
+
+def test_plan_keys_on_grid_and_cover_members():
+    plans = plan_sweep(HET, read_bucket=8, band_bucket=16, len_bucket=64)
+    for p in plans:
+        n_pad, l_pad, t_max, k0 = p.key
+        assert n_pad % 8 == 0 and l_pad % 64 == 0 and t_max % 64 == 0
+        assert k0 % 16 == 0
+        for ch in p.chunks:
+            for i in ch:
+                c = HET[i]
+                assert len(c) <= n_pad
+                assert max(len(r) for r in c) <= l_pad
+                # tlen0 + 2 <= Tmax leaves insertion room for the seed
+                assert len(c[0]) + 2 <= t_max
+
+
+def test_plan_pinned_chunk_shapes():
+    """cluster_chunk splits every bucket into chunks PADDED TO ONE gp —
+    the executable-reuse fix: a tail chunk never gets its own shape."""
+    plans = plan_sweep(HET, cluster_chunk=2, n_axis=1)
+    assert sum(len(p.chunks) for p in plans) > len(plans)  # chunking happened
+    for p in plans:
+        for ch in p.chunks:
+            assert 0 < len(ch) <= p.gp
+    # the big bucket splits into multiple chunks that all share one gp
+    big = max(plans, key=lambda p: sum(len(c) for c in p.chunks))
+    assert len(big.chunks) > 1
+    assert all(len(c) == big.gp for c in big.chunks[:-1])
+
+
+def test_plan_gp_respects_mesh_axis():
+    for n_axis in (1, 2, 3, 8):
+        for p in plan_sweep(HET, n_axis=n_axis):
+            assert p.gp % n_axis == 0
+        for p in plan_sweep(HET, scheduler="uniform", n_axis=n_axis):
+            assert p.gp % n_axis == 0
+
+
+def test_uniform_is_single_global_bucket():
+    plans = plan_sweep(HET, scheduler="uniform")
+    assert len(plans) == 1
+    p = plans[0]
+    assert p.band == 8
+    assert p.key[0] == max(len(c) for c in HET)  # raw read count
+    assert p.key[1] == 320  # bucket(300, 64)
+    assert len(p.chunks) == 1 and len(p.chunks[0]) == len(HET)
+
+
+def test_bucketed_never_pads_more_than_uniform():
+    """The point of the scheduler: heterogeneous inputs allocate fewer
+    padded device cells bucketed than uniform."""
+    bucketed = plan_cells(plan_sweep(HET))
+    uniform = plan_cells(plan_sweep(HET, scheduler="uniform"))
+    assert bucketed < uniform
+    # homogeneous inputs: bucketing can't lose to within-grid rounding
+    homog = [_cluster(8, 64) for _ in range(8)]
+    assert plan_cells(plan_sweep(homog)) <= plan_cells(
+        plan_sweep(homog, scheduler="uniform")
+    )
+
+
+def test_unknown_scheduler_rejected():
+    with pytest.raises(ValueError):
+        plan_sweep(HET, scheduler="magic")
+
+
+def test_pipeline_map_order_and_overlap():
+    """Results come back in item order; item k's collect happens only
+    AFTER item k+1's dispatch (the double-buffer schedule)."""
+    events = []
+    lock = threading.Lock()
+
+    def log(tag, x):
+        with lock:
+            events.append((tag, x))
+
+    def pack(x):
+        log("pack", x)
+        return x * 10
+
+    def run(p):
+        log("run", p // 10)
+        return p + 1
+
+    def collect(h):
+        log("collect", (h - 1) // 10)
+        return h
+
+    out = pipeline_map(pack, run, collect, [0, 1, 2, 3])
+    assert out == [1, 11, 21, 31]
+    order = {("run", i): k for k, (t, i) in enumerate(events) if t == "run"}
+    for t, i in events:
+        if t == "collect" and i + 1 < 4:
+            assert order[("run", i + 1)] < events.index(("collect", i))
+
+
+def test_pipeline_map_empty_and_single():
+    assert pipeline_map(lambda x: x, lambda x: x, lambda x: x, []) == []
+    assert pipeline_map(
+        lambda x: x + 1, lambda x: x * 2, lambda x: x - 1, [5]
+    ) == [11]
+
+
+def test_pipeline_map_propagates_errors():
+    def bad_run(p):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        pipeline_map(lambda x: x, bad_run, lambda x: x, [1, 2])

@@ -65,11 +65,14 @@ def test_sweep_matches_per_cluster_driver(use_mesh):
         assert res[g].converged == ref.state.converged, g
 
 
-def test_sweep_uneven_clusters():
-    """Ragged cluster sizes and read lengths pad cleanly."""
+@pytest.mark.parametrize("scheduler", ["bucketed", "uniform"])
+def test_sweep_uneven_clusters(scheduler):
+    """Ragged cluster sizes and read lengths pad cleanly under both
+    schedulers."""
     clusters, templates = _clusters(3, seed=5)
     clusters[1] = clusters[1][:4]  # fewer reads
-    res = sweep_clusters_sharded(clusters, mesh=make_mesh(8))
+    res = sweep_clusters_sharded(clusters, mesh=make_mesh(8),
+                                 scheduler=scheduler)
     for g, r in enumerate(res):
         seqs = [x.seq for x in clusters[g]]
         log_ps = [x.error_log_p for x in clusters[g]]
@@ -80,3 +83,63 @@ def test_sweep_uneven_clusters():
                                 device_loop="on"),
         )
         assert np.array_equal(r.consensus, ref.consensus), g
+
+
+def test_sweep_shuffled_inputs_restore_order():
+    """Heterogeneous clusters landing in different shape buckets, fed in
+    shuffled order: results come back in INPUT order, each bit-identical
+    to the per-cluster driver."""
+    rng = np.random.default_rng(11)
+    pool = []
+    for nseqs, length, seed in [(4, 50, 1), (8, 90, 2), (5, 50, 3),
+                                (8, 92, 4), (4, 52, 5)]:
+        c, _ = _clusters(1, nseqs=nseqs, length=length, seed=seed)
+        pool.append(c[0])
+    shuffled = [pool[i] for i in rng.permutation(len(pool))]
+    res, stats = sweep_clusters_sharded(shuffled, return_stats=True)
+    assert stats.n_buckets > 1  # the permutation spans buckets
+    assert len(res) == len(shuffled)
+    for g, reads in enumerate(shuffled):
+        ref = rifraf(
+            [r.seq for r in reads],
+            error_log_ps=[r.error_log_p for r in reads],
+            params=RifrafParams(batch_size=0, batch_fixed=False,
+                                do_alignment_proposals=False,
+                                device_loop="on"),
+        )
+        assert np.array_equal(res[g].consensus, ref.consensus), g
+        assert np.isclose(res[g].score, ref.state.score, rtol=1e-6), g
+
+
+def test_sweep_alignment_proposals_matches_driver():
+    """do_alignment_proposals=True sweep scope: the in-kernel edits gate
+    under the cluster vmap must reproduce the per-cluster driver run in
+    the same configuration."""
+    clusters, _ = _clusters(3, seed=9)
+    res = sweep_clusters_sharded(clusters, do_alignment_proposals=True)
+    for g, reads in enumerate(clusters):
+        ref = rifraf(
+            [r.seq for r in reads],
+            error_log_ps=[r.error_log_p for r in reads],
+            params=RifrafParams(batch_size=0, batch_fixed=False,
+                                do_alignment_proposals=True,
+                                device_loop="on"),
+        )
+        assert np.array_equal(res[g].consensus, ref.consensus), g
+        assert np.isclose(res[g].score, ref.state.score, rtol=1e-6), g
+        assert res[g].n_iters == int(ref.state.stage_iterations.sum()), g
+        assert res[g].converged == ref.state.converged, g
+
+
+def test_sweep_chunked_matches_unchunked():
+    """Pinned chunk shapes: a chunked sweep is bit-identical to the
+    unchunked one (same bucket grid, tail chunks padded to the same
+    cluster count)."""
+    clusters, _ = _clusters(5, seed=13)
+    whole = sweep_clusters_sharded(clusters)
+    chunked = sweep_clusters_sharded(clusters, cluster_chunk=2)
+    for a, b in zip(whole, chunked):
+        assert np.array_equal(a.consensus, b.consensus)
+        assert a.score == b.score
+        assert a.n_iters == b.n_iters
+        assert a.converged == b.converged

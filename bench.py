@@ -40,6 +40,15 @@ Other modes (results appended to BASELINE.md, not the driver JSON):
                lengths): bucketed vs uniform scheduler seconds and
                padding-waste ratios (--sweep-n / --sweep-chunk override
                the cluster count / chunk size for smoke runs)
+  --serve      serve_poisson_1k: the online consensus service
+               (rifraf_tpu.serve) on 1000 log-normal-length requests —
+               burst throughput of micro-batching vs the naive
+               one-request-per-dispatch server (the >=2x claim), a
+               Poisson-arrivals pass for latency percentiles, and the
+               offline sharded sweep on the identical clusters as the
+               throughput ceiling / bit-identity reference (--serve-n
+               overrides the request count for smoke runs; slow-only
+               in CI)
   --quick      headline only (skip the north-star / ref-default extras)
 """
 
@@ -343,6 +352,137 @@ def _sweep_mode():
     print(json.dumps(out))
 
 
+def _serve_workload(n_requests, rng):
+    """Heterogeneous serving workload: log-normal template lengths and
+    ragged cluster sizes (the --sweep distribution, so the serve numbers
+    are comparable to the offline sweep's)."""
+    from rifraf_tpu.engine.params import RifrafParams
+    from rifraf_tpu.models.errormodel import ErrorModel
+    from rifraf_tpu.models.sequences import make_read_scores
+    from rifraf_tpu.sim.sample import sample_sequences
+    from rifraf_tpu.utils.phred import phred_to_log_p
+
+    params = RifrafParams()
+    seq_errors = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+    clusters = []
+    for _ in range(n_requests):
+        tlen = int(np.clip(rng.lognormal(np.log(250), 0.5), 60, 1500))
+        nseqs = int(rng.integers(3, 13))
+        _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+            nseqs=nseqs, length=tlen, error_rate=0.02, rng=rng,
+            seq_errors=seq_errors,
+        )
+        clusters.append([
+            make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                             params.bandwidth, params.scores)
+            for s, p in zip(seqs, phreds)
+        ])
+    return clusters
+
+
+def _serve_burst(clusters, config):
+    """submit_many as fast as the backpressure allows; returns
+    (throughput_rps, responses, stats_snapshot)."""
+    from rifraf_tpu.serve import ConsensusServer, submit_many
+
+    server = ConsensusServer(config)
+    try:
+        server.warmup(clusters, batch_sizes=(1, config.max_batch))
+        t0 = time.perf_counter()
+        responses = submit_many(clusters, server=server)
+        wall = time.perf_counter() - t0
+        snap = server.snapshot()
+    finally:
+        server.close()
+    assert all(r.ok for r in responses)
+    return len(clusters) / wall, responses, snap
+
+
+def _serve_mode():
+    """serve_poisson_1k: online service vs naive dispatch vs offline
+    sweep on the identical heterogeneous workload."""
+    import jax
+
+    from rifraf_tpu.parallel.sharding import make_mesh
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+    from rifraf_tpu.serve import ConsensusServer, ServeConfig
+
+    n_requests = 1000
+    if "--serve-n" in sys.argv:
+        n_requests = int(sys.argv[sys.argv.index("--serve-n") + 1])
+    max_batch = 16
+    if "--serve-batch" in sys.argv:
+        max_batch = int(sys.argv[sys.argv.index("--serve-batch") + 1])
+
+    rng = np.random.default_rng(12)
+    clusters = _serve_workload(n_requests, rng)
+    mesh = make_mesh() if len(jax.devices()) > 1 else None
+
+    out = {
+        "config": f"serve_poisson_{n_requests}",
+        "backend": jax.default_backend(),
+        "n_requests": n_requests,
+    }
+
+    # 1. burst throughput: micro-batched vs naive one-request-per-
+    # dispatch (max_batch=1 — every request is its own device program
+    # invocation, the no-batcher strawman)
+    batched_cfg = ServeConfig(max_wait_ms=5.0, max_batch=max_batch,
+                              mesh=mesh)
+    naive_cfg = ServeConfig(max_batch=1, mesh=mesh)
+    rps_batched, responses, snap = _serve_burst(clusters, batched_cfg)
+    rps_naive, _, _ = _serve_burst(clusters, naive_cfg)
+    out["throughput_rps"] = round(rps_batched, 2)
+    out["naive_rps"] = round(rps_naive, 2)
+    out["speedup_vs_naive"] = round(rps_batched / rps_naive, 2)
+    out["batch_occupancy"] = snap["batch_occupancy"]
+    out["padding_waste"] = snap["padding_waste"]
+    out["batches"] = snap["batches"]
+
+    # 2. Poisson arrivals at half the measured burst throughput: the
+    # open-loop latency the service shows with steady-state headroom
+    lam = max(rps_batched * 0.5, 1.0)
+    out["poisson_rate_rps"] = round(lam, 2)
+    from rifraf_tpu.serve import QueueFullError
+
+    server = ConsensusServer(ServeConfig(max_wait_ms=5.0,
+                                         max_batch=max_batch, mesh=mesh))
+    try:
+        server.warmup(clusters, batch_sizes=(1, batched_cfg.max_batch))
+        futures = []
+        for c in clusters:
+            while True:
+                try:
+                    futures.append(server.submit(c))
+                    break
+                except QueueFullError:
+                    # open-loop overload: wait out the oldest in flight
+                    futures[0].result()
+            time.sleep(rng.exponential(1.0 / lam))
+        for f in futures:
+            f.result()
+        psnap = server.snapshot()
+    finally:
+        server.close()
+    out["latency_ms"] = psnap["latency_ms"]
+    out["timers"] = psnap["timers"]
+
+    # 3. offline sharded sweep on the SAME clusters: the batch-mode
+    # throughput ceiling, and the bit-identity reference for the served
+    # results
+    sweep_clusters_sharded(clusters, mesh=mesh)  # warm-up compiles
+    t0 = time.perf_counter()
+    offline, _ = sweep_clusters_sharded(clusters, mesh=mesh,
+                                        return_stats=True)
+    offline_wall = time.perf_counter() - t0
+    out["offline_sweep_rps"] = round(n_requests / offline_wall, 2)
+    out["results_match_offline"] = all(
+        np.array_equal(r.consensus, o.consensus) and r.score == o.score
+        for r, o in zip(responses, offline)
+    )
+    print(json.dumps(out))
+
+
 def main():
     if "--cpu" in sys.argv:
         import os
@@ -370,6 +510,9 @@ def main():
         return 0
     if "--sweep" in sys.argv:
         _sweep_mode()
+        return 0
+    if "--serve" in sys.argv:
+        _serve_mode()
         return 0
     if "--refdefault" in sys.argv:
         # standalone ref-default measurement (use with --cpu to

@@ -1,0 +1,278 @@
+"""rifraf-serve: the online consensus service CLI.
+
+Reads JSONL requests — one cluster per line — from stdin (default) or a
+watched directory, serves them through ``serve.ConsensusServer``
+(continuous micro-batching, deadlines, backpressure), and writes JSONL
+responses in completion order.
+
+Request line schema::
+
+    {"id": "r1",                  # optional; generated when absent
+     "seqs": ["ACGT...", ...],    # required, one string per read
+     "phreds": [[20, 20, ...]],   # per-read phred ints ...
+     "quals": ["IIII...", ...],   # ... or FASTQ quality strings
+     "deadline_ms": 500}          # optional per-request deadline
+
+Response line schema (``serve.Response.to_json_dict``)::
+
+    {"id": "r1", "ok": true, "consensus": "ACGT...", "score": -12.3,
+     "n_iters": 4, "converged": true, "latency_ms": 18.2,
+     "path": "batched"}
+    {"id": "r2", "ok": false, "error": "deadline_exceeded",
+     "message": "...", "latency_ms": 501.0}
+
+In ``--watch DIR`` mode, every ``*.jsonl`` file that appears in DIR is
+served and answered to ``<name>.out.jsonl`` alongside it; files must be
+complete when they appear (write elsewhere and rename in). ``--stats``
+prints the server's metrics snapshot (queue depth, batch occupancy,
+padding waste, latency percentiles, timers) as JSON to stderr on exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+from ..serve import (
+    ConsensusServer,
+    QueueFullError,
+    ServeConfig,
+    ServeError,
+    encode_cluster,
+)
+from ..utils.phred import cap_phreds
+from .consensus import parse_error_model
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rifraf-serve",
+        description="Online consensus service: JSONL requests in, "
+                    "JSONL responses out.",
+    )
+    p.add_argument("--input", default="-",
+                   help="JSONL request file, '-' for stdin (default)")
+    p.add_argument("--output", default="-",
+                   help="JSONL response file, '-' for stdout (default)")
+    p.add_argument("--watch", default="",
+                   help="serve *.jsonl files appearing in this directory "
+                        "instead of --input; responses go to "
+                        "<name>.out.jsonl next to each input")
+    p.add_argument("--watch-once", action="store_true",
+                   help="with --watch: serve the files present now, then "
+                        "exit (instead of polling forever)")
+    p.add_argument("--watch-poll-ms", type=float, default=200.0,
+                   help="with --watch: directory poll interval")
+    p.add_argument("--seq-errors", default="",
+                   help="comma-separated sequence error ratios "
+                        "(mismatch, insertion, deletion); default scores "
+                        "when omitted")
+    p.add_argument("--phred-cap", type=int, default=0,
+                   help="maximum PHRED score (0 = no cap)")
+    p.add_argument("--max-iters", type=int, default=100)
+    p.add_argument("--alignment-proposals", action="store_true",
+                   help="use the full single-indel proposal pass instead "
+                        "of the seeded edits gate")
+    p.add_argument("--max-batch", type=int, default=16,
+                   help="micro-batch occupancy flush threshold")
+    p.add_argument("--max-wait-ms", type=float, default=20.0,
+                   help="micro-batch latency flush threshold")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="bounded admission queue size (backpressure)")
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="default per-request deadline applied to requests "
+                        "without their own (0 = none)")
+    p.add_argument("--warmup-file", default="",
+                   help="JSONL file of example requests whose shape "
+                        "buckets are pre-traced before serving")
+    p.add_argument("--stats", action="store_true",
+                   help="print the metrics snapshot as JSON to stderr "
+                        "on exit")
+    p.add_argument("--verbose", "-v", type=int, default=0)
+    return p
+
+
+def config_from_args(args) -> ServeConfig:
+    kw = dict(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue,
+        max_iters=args.max_iters,
+        do_alignment_proposals=args.alignment_proposals,
+    )
+    if args.seq_errors:
+        kw["scores"] = parse_error_model(args.seq_errors)
+    return ServeConfig(**kw)
+
+
+def parse_request(obj: dict, args, config: ServeConfig):
+    """One decoded request object -> (cluster, deadline_ms). Raises
+    ValueError on malformed input."""
+    seqs = obj.get("seqs")
+    if not seqs:
+        raise ValueError("request needs a non-empty 'seqs' list")
+    if "phreds" in obj:
+        phreds = [np.asarray(p, float) for p in obj["phreds"]]
+    elif "quals" in obj:
+        phreds = [
+            np.asarray([ord(c) - 33 for c in q], float)
+            for q in obj["quals"]
+        ]
+    else:
+        raise ValueError("request needs 'phreds' or 'quals'")
+    if len(phreds) != len(seqs):
+        raise ValueError("'seqs' and quality lists differ in length")
+    if args.phred_cap > 0:
+        phreds = [cap_phreds(p, args.phred_cap) for p in phreds]
+    cluster = encode_cluster(seqs, phreds=phreds, config=config)
+    deadline_ms = obj.get("deadline_ms")
+    if deadline_ms is None and args.deadline_ms > 0:
+        deadline_ms = args.deadline_ms
+    return cluster, deadline_ms
+
+
+class _Emitter:
+    """Serialized completion-order JSONL writer (future callbacks fire
+    on server threads)."""
+
+    def __init__(self, fh):
+        self.fh = fh
+        self.lock = threading.Lock()
+
+    def emit(self, obj: dict) -> None:
+        with self.lock:
+            self.fh.write(json.dumps(obj) + "\n")
+            self.fh.flush()
+
+    def emit_response(self, fut) -> None:
+        self.emit(fut.result().to_json_dict())
+
+
+def serve_stream(lines, server: ConsensusServer, emitter: _Emitter,
+                 args, config: ServeConfig) -> int:
+    """Submit every JSONL line, riding backpressure; responses stream
+    out via future callbacks. Returns the number of requests admitted."""
+    inflight: deque = deque()
+    n = 0
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        rid = None
+        try:
+            obj = json.loads(line)
+            rid = obj.get("id")  # kept even when the rest is malformed
+            cluster, deadline_ms = parse_request(obj, args, config)
+        except (ValueError, KeyError, TypeError) as e:
+            emitter.emit({"id": rid or f"line{i}", "ok": False,
+                          "error": "bad_request", "message": str(e)})
+            continue
+        while True:
+            try:
+                fut = server.submit(cluster, request_id=rid,
+                                    deadline_ms=deadline_ms)
+                break
+            except QueueFullError:
+                # backpressure: wait out the oldest in-flight request
+                if inflight:
+                    inflight.popleft().result()
+                else:
+                    time.sleep(1e-3)
+            except ServeError as e:
+                fut = None
+                emitter.emit({"id": rid or f"line{i}", "ok": False,
+                              "error": e.code, "message": str(e)})
+                break
+        if fut is not None:
+            inflight.append(fut)
+            fut.add_done_callback(emitter.emit_response)
+            n += 1
+    while inflight:
+        inflight.popleft().result()
+    return n
+
+
+def _warmup(server: ConsensusServer, path: str, args,
+            config: ServeConfig, verbose: int) -> None:
+    examples = []
+    with open(path) as fh:
+        for line in fh:
+            if line.strip():
+                cluster, _ = parse_request(json.loads(line), args, config)
+                examples.append(cluster)
+    t0 = time.perf_counter()
+    n = server.warmup(examples, batch_sizes=(1, config.max_batch))
+    if verbose >= 1:
+        print(
+            f"warmup: {n} executable(s) traced in "
+            f"{time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+
+
+def _run_watch(server: ConsensusServer, args,
+               config: ServeConfig) -> None:
+    done = set()
+    while True:
+        fresh = sorted(
+            f for f in os.listdir(args.watch)
+            if f.endswith(".jsonl") and not f.endswith(".out.jsonl")
+            and f not in done
+        )
+        for name in fresh:
+            path = os.path.join(args.watch, name)
+            out_path = path[: -len(".jsonl")] + ".out.jsonl"
+            if args.verbose >= 1:
+                print(f"serving '{path}' -> '{out_path}'",
+                      file=sys.stderr)
+            with open(path) as infh, open(out_path, "w") as outfh:
+                serve_stream(infh, server, _Emitter(outfh), args, config)
+            done.add(name)
+        if args.watch_once:
+            return
+        time.sleep(args.watch_poll_ms / 1e3)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    config = config_from_args(args)
+    server = ConsensusServer(config)
+    try:
+        if args.warmup_file:
+            _warmup(server, args.warmup_file, args, config, args.verbose)
+        if args.watch:
+            _run_watch(server, args, config)
+        else:
+            infh = sys.stdin if args.input == "-" else open(args.input)
+            outfh = (sys.stdout if args.output == "-"
+                     else open(args.output, "w"))
+            try:
+                n = serve_stream(infh, server, _Emitter(outfh), args,
+                                 config)
+                if args.verbose >= 1:
+                    print(f"served {n} request(s)", file=sys.stderr)
+            finally:
+                if infh is not sys.stdin:
+                    infh.close()
+                if outfh is not sys.stdout:
+                    outfh.close()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+        if args.stats:
+            print(json.dumps(server.snapshot(), indent=2),
+                  file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,7 +101,11 @@ class RifrafResult:
     aln_error_probs: Optional[np.ndarray] = None
     timers: Optional[Timers] = None
     # execution metadata: {"stage_paths": {stage name -> "device_loop" /
-    # "host (...reason...)" / "host"}} — which engine ran each stage
+    # "host (...reason...)" / "host"}, "declines": [{"stage", "reason"},
+    # ...]} — which engine ran each stage, and every device-loop decline
+    # the run hit (the per-stage reasons logged at verbose>=1, collected
+    # so callers — e.g. the serving stats — can count fallbacks without
+    # parsing logs)
     metadata: Optional[dict] = None
 
 
@@ -879,7 +883,16 @@ def rifraf(
         state=state,
         consensus_stages=consensus_stages,
         timers=timers,
-        metadata={"stage_paths": dict(state.stage_paths)},
+        metadata={
+            "stage_paths": dict(state.stage_paths),
+            "declines": [
+                {"stage": stage.name, "reason": reason}
+                for stage, reason in sorted(
+                    state.device_declines,
+                    key=lambda kv: (int(kv[0]), kv[1]),
+                )
+            ],
+        },
     )
     if params.do_score:
         _log(params, 2, "computing consensus quality scores")

@@ -1,4 +1,9 @@
-from .cluster import pipeline_map, resolve_jobs_flag, sweep_clusters
+from .cluster import (
+    PipelineJobError,
+    pipeline_map,
+    resolve_jobs_flag,
+    sweep_clusters,
+)
 from .sharding import (
     READS_AXIS,
     make_mesh,
@@ -9,8 +14,11 @@ from .sharding import (
 from .sweep_sharded import (
     BucketPlan,
     BucketStats,
+    ChunkExecutor,
     SweepResult,
     SweepStats,
+    bucket_key,
+    cluster_info,
     plan_sweep,
     sweep_clusters_sharded,
 )

@@ -106,11 +106,29 @@ def sweep_clusters(
             raise
 
 
+class PipelineJobError(Exception):
+    """One pipeline item failed. Carries the originating job index (and
+    the failing stage name); the causing exception is ``__cause__``.
+    With ``pipeline_map(..., on_error="return")`` these appear in the
+    result list instead of aborting the remaining jobs — the serve
+    worker depends on that isolation to fail one micro-batch's requests
+    without stalling the batches behind it."""
+
+    def __init__(self, job_index: int, stage: str, cause: BaseException):
+        super().__init__(
+            f"pipeline job {job_index} failed in {stage}: {cause!r}"
+        )
+        self.job_index = job_index
+        self.stage = stage
+        self.__cause__ = cause
+
+
 def pipeline_map(
     pack_fn: Callable[[T], object],
     run_fn: Callable[[object], object],
     collect_fn: Callable[[object], R],
     items: Sequence[T],
+    on_error: str = "raise",
 ) -> List[R]:
     """Two-deep host/device software pipeline over ``items``.
 
@@ -127,24 +145,52 @@ def pipeline_map(
 
     One background thread (not a pool): packing is NumPy-bound and the
     pipeline only ever needs the next item early. Results come back in
-    item order. Exceptions from any stage propagate to the caller.
+    item order.
+
+    ``on_error="raise"`` (default) propagates the first exception from
+    any stage to the caller unchanged. ``on_error="return"`` isolates
+    failures per job: a failing item's result slot holds a
+    PipelineJobError naming the job index and stage (its remaining
+    stages are skipped), and every other item still runs to completion.
     """
+    if on_error not in ("raise", "return"):
+        raise ValueError(f"unknown on_error: {on_error!r}")
     items = list(items)
     if not items:
         return []
+
+    def pack(i: int, item: T):
+        try:
+            return pack_fn(item)
+        except Exception as e:  # noqa: BLE001 — isolation is the point
+            if on_error == "raise":
+                raise
+            return PipelineJobError(i, "pack", e)
+
+    def step(i: int, stage: str, fn, arg):
+        if isinstance(arg, PipelineJobError):
+            return arg  # an earlier stage already failed this job
+        try:
+            return fn(arg)
+        except Exception as e:  # noqa: BLE001
+            if on_error == "raise":
+                raise
+            return PipelineJobError(i, stage, e)
+
     out: List[R] = []
     with ThreadPoolExecutor(max_workers=1) as pool:
-        nxt = pool.submit(pack_fn, items[0])
-        pending = None  # device handle for the previous item
+        nxt = pool.submit(pack, 0, items[0])
+        pending = None  # (index, device handle) for the previous item
         for i in range(len(items)):
             packed = nxt.result()
             if i + 1 < len(items):
-                nxt = pool.submit(pack_fn, items[i + 1])
-            handle = run_fn(packed)
+                nxt = pool.submit(pack, i + 1, items[i + 1])
+            handle = step(i, "run", run_fn, packed)
             if pending is not None:
-                out.append(collect_fn(pending))
-            pending = handle
-        out.append(collect_fn(pending))
+                out.append(step(pending[0], "collect", collect_fn,
+                                pending[1]))
+            pending = (i, handle)
+        out.append(step(pending[0], "collect", collect_fn, pending[1]))
     return out
 
 

@@ -146,6 +146,30 @@ def _cluster_infos(
     return infos
 
 
+def cluster_info(cluster: Sequence[ReadScores]) -> _ClusterInfo:
+    """Per-cluster shape/seed facts for ONE cluster (the serving
+    admission path computes these once per request)."""
+    return _cluster_infos([cluster])[0]
+
+
+def bucket_key(
+    info: _ClusterInfo,
+    read_bucket: int = READ_BUCKET,
+    band_bucket: int = BAND_BUCKET,
+    len_bucket: int = 64,
+) -> Tuple[int, int, int, int]:
+    """The bucketed scheduler's shape key ``(Npad, Lpad, Tmax, K0)`` for
+    one cluster. Single definition shared by plan_sweep and the serving
+    micro-batcher, so an online request and an offline sweep cluster
+    with the same rounded shape land on the SAME compiled executable."""
+    return (
+        _bucket(info.n_reads, read_bucket),
+        _bucket(info.max_len, len_bucket),
+        _bucket(info.tlen0 + 2, len_bucket),
+        _bucket(info.entry_k, band_bucket),
+    )
+
+
 def plan_sweep(
     clusters: Sequence[Sequence[ReadScores]],
     scheduler: str = "bucketed",
@@ -194,12 +218,7 @@ def plan_sweep(
         grid = max(n_axis, 1)
         groups = {}
         for i, info in enumerate(infos):
-            key = (
-                _bucket(info.n_reads, read_bucket),
-                _bucket(info.max_len, len_bucket),
-                _bucket(info.tlen0 + 2, len_bucket),
-                _bucket(info.entry_k, band),
-            )
+            key = bucket_key(info, read_bucket, band, len_bucket)
             groups.setdefault(key, []).append(i)
 
     plans = []
@@ -287,82 +306,58 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
     return jax.jit(call, donate_argnums=(2,) if donate else ())
 
 
-def sweep_clusters_sharded(
-    clusters: Sequence[Sequence[ReadScores]],
-    mesh=None,
-    max_iters: int = 100,
-    min_dist: int = 15,
-    bandwidth_pvalue: float = 0.1,
-    len_bucket: int = 64,
-    cluster_chunk: int = 0,
-    scheduler: str = "bucketed",
-    read_bucket: int = READ_BUCKET,
-    band_bucket: int = BAND_BUCKET,
-    do_alignment_proposals: bool = False,
-    return_stats: bool = False,
-):
-    """One consensus per cluster, all clusters in one device program.
+class ChunkExecutor:
+    """Pack/run/collect engine for one bucket chunk — the device side of
+    sweep_clusters_sharded, factored out so the online consensus service
+    (rifraf_tpu.serve) drives the SAME module-level lru-cached program
+    factories (_adapt_program/_stage_program) and padding rules. A
+    serving micro-batch and an offline sweep chunk with one bucket
+    signature share one compiled executable.
 
-    ``clusters``: per-cluster ReadScores lists (build with
-    make_read_scores). ``mesh``: optional Mesh whose FIRST axis shards
-    the cluster dimension; None runs unsharded on the default device.
-    ``cluster_chunk`` > 0 processes the cluster axis in sequential
-    chunks of (up to) that size (bands for every in-flight cluster live
-    in HBM simultaneously — a 1024-cluster batch can exceed one chip);
-    the effective chunk size rounds up to the cluster grid so all
-    chunks share one shape. ``scheduler``/``read_bucket``/
-    ``band_bucket``: see plan_sweep. ``do_alignment_proposals`` enables
-    the in-kernel alignment-edits candidate gate (the driver default),
-    matching ``rifraf(..., do_alignment_proposals=True)``.
-
-    Returns the per-cluster results IN INPUT ORDER; with
-    ``return_stats`` also a SweepStats (per-bucket occupancy, padding
-    waste, and timing).
+    The three methods are shaped for parallel.cluster.pipeline_map:
+    ``pack`` is pure NumPy (safe on the pipeline's background thread),
+    ``run`` dispatches asynchronously and returns an un-fetched handle,
+    ``collect`` is the blocking fetch.
     """
-    t_start = time.perf_counter()
-    G = len(clusters)
-    infos = _cluster_infos(clusters)
-    n_axis = mesh.devices.size if mesh is not None else 1
-    plans = plan_sweep(
-        clusters, scheduler=scheduler, read_bucket=read_bucket,
-        band_bucket=band_bucket, len_bucket=len_bucket,
-        cluster_chunk=cluster_chunk, n_axis=n_axis, infos=infos,
-    )
-    if G == 0:
-        stats = SweepStats(0, 0, 0, 0, 0, 0.0, 0, 0.0, [])
-        return ([], stats) if return_stats else []
 
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec
+    def __init__(self, mesh=None, max_iters: int = 100, min_dist: int = 15,
+                 bandwidth_pvalue: float = 0.1,
+                 do_alignment_proposals: bool = False):
+        import jax
 
-    from ..engine.device_loop import MAX_DRIFT, unpack_stage_packed
-    from ..engine.params import resolve_dtype
+        from ..engine.params import resolve_dtype
 
-    dtype = resolve_dtype(None)
-    H = max_iters + 1
-    donate = jax.default_backend() != "cpu"
-    shard = (
-        (lambda a, *spec: jax.device_put(
-            a, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0], *spec))
-        ))
-        if mesh is not None
-        else (lambda a, *spec: jnp.asarray(a))
-    )
+        self.mesh = mesh
+        self.max_iters = max_iters
+        self.H = max_iters + 1
+        self.min_dist = min_dist
+        self.bandwidth_pvalue = bandwidth_pvalue
+        self.use_edits = do_alignment_proposals
+        self.dtype = resolve_dtype(None)
+        self.donate = jax.default_backend() != "cpu"
 
-    tasks = [
-        (bi, plan, chunk)
-        for bi, plan in enumerate(plans)
-        for chunk in plan.chunks
-    ]
+    def _shard(self, a, *spec):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
 
-    def pack(task):
-        """Host side of one chunk: batch, pad, and threshold — runs on
-        the pipeline's background thread while the previous chunk
-        executes on device."""
-        bi, plan, idxs = task
+        if self.mesh is None:
+            return jnp.asarray(a)
+        return jax.device_put(
+            a,
+            NamedSharding(
+                self.mesh, PartitionSpec(self.mesh.axis_names[0], *spec)
+            ),
+        )
+
+    def pack(self, plan: BucketPlan, idxs: Sequence[int], clusters,
+             infos) -> dict:
+        """Host side of one chunk: batch, pad, and threshold. ``idxs``
+        index into ``clusters``/``infos``; runs on the pipeline's
+        background thread while the previous chunk executes on device."""
         N, L, Tmax, _ = plan.key
         Gp = plan.gp
+        dtype = self.dtype
         seqs = np.zeros((Gp, N, L), np.int8)
         match = np.zeros((Gp, N, L), dtype)
         mismatch = np.zeros((Gp, N, L), dtype)
@@ -398,27 +393,29 @@ def sweep_clusters_sharded(
             seed = c[info.seed_idx]
             tmpl0[g, : len(seed)] = seed.seq
         thresholds = np.array([
-            [poisson_cquantile(est_err[g, k], bandwidth_pvalue)
+            [poisson_cquantile(est_err[g, k], self.bandwidth_pvalue)
              for k in range(N)] for g in range(Gp)
         ])
         return {
-            "task": task, "seqs": seqs, "match": match,
-            "mismatch": mismatch, "ins": ins, "dels": dels,
-            "lengths": lengths, "weights": weights,
+            "plan": plan, "idxs": list(idxs), "seqs": seqs,
+            "match": match, "mismatch": mismatch, "ins": ins,
+            "dels": dels, "lengths": lengths, "weights": weights,
             "bandwidths": bandwidths, "est_err": est_err,
             "thresholds": thresholds, "tlens0": tlens0, "tmpl0": tmpl0,
         }
 
-    bucket_seconds = [0.0] * len(plans)
-
-    def run(p):
+    def run(self, p: dict):
         """Device side of one chunk: adaptive-bandwidth rounds (each a
         blocking fetch of n_errors), then ONE async stage dispatch —
         returns the un-fetched packed handle so the next chunk can pack
-        and dispatch before we block on it."""
-        t0 = time.perf_counter()
-        bi, plan, idxs = p["task"]
+        and dispatch before anyone blocks on it."""
+        import jax.numpy as jnp
+
+        from ..engine.device_loop import MAX_DRIFT
+
+        plan, idxs = p["plan"], p["idxs"]
         _, _, Tmax, _ = plan.key
+        shard = self._shard
         lengths, weights = p["lengths"], p["weights"]
         bandwidths, tlens0 = p["bandwidths"], p["tlens0"]
 
@@ -479,26 +476,106 @@ def sweep_clusters_sharded(
             shard(bandwidths, None), w_d,
         )
         packed = _stage_program(
-            Tmax, K, H, min_dist, do_alignment_proposals, donate
+            Tmax, K, self.H, self.min_dist, self.use_edits, self.donate
         )(t0_d, tl_d, step_state)
-        bucket_seconds[bi] += time.perf_counter() - t0
-        return packed, p["task"]
+        return packed, plan, idxs
 
-    out: List[Optional[SweepResult]] = [None] * G
+    def collect(self, handle) -> List[SweepResult]:
+        """Blocking fetch + unpack: one SweepResult per index of the
+        chunk, in ``idxs`` order (padding slots dropped)."""
+        from ..engine.device_loop import unpack_stage_packed
 
-    def collect(handle):
-        packed_dev, (bi, plan, idxs) = handle
-        t0 = time.perf_counter()
+        packed_dev, plan, idxs = handle
         packed = np.asarray(packed_dev)
         Tmax = plan.key[2]
-        for g, ci in enumerate(idxs):
+        results = []
+        for g in range(len(idxs)):
             tlen, total, n_rec, completed, _, _, _, tmpl = (
-                unpack_stage_packed(packed[g], H, Tmax)
+                unpack_stage_packed(packed[g], self.H, Tmax)
             )
-            out[ci] = SweepResult(
+            results.append(SweepResult(
                 consensus=tmpl[:tlen], score=total, n_iters=n_rec,
                 converged=completed,
-            )
+            ))
+        return results
+
+
+def sweep_clusters_sharded(
+    clusters: Sequence[Sequence[ReadScores]],
+    mesh=None,
+    max_iters: int = 100,
+    min_dist: int = 15,
+    bandwidth_pvalue: float = 0.1,
+    len_bucket: int = 64,
+    cluster_chunk: int = 0,
+    scheduler: str = "bucketed",
+    read_bucket: int = READ_BUCKET,
+    band_bucket: int = BAND_BUCKET,
+    do_alignment_proposals: bool = False,
+    return_stats: bool = False,
+):
+    """One consensus per cluster, all clusters in one device program.
+
+    ``clusters``: per-cluster ReadScores lists (build with
+    make_read_scores). ``mesh``: optional Mesh whose FIRST axis shards
+    the cluster dimension; None runs unsharded on the default device.
+    ``cluster_chunk`` > 0 processes the cluster axis in sequential
+    chunks of (up to) that size (bands for every in-flight cluster live
+    in HBM simultaneously — a 1024-cluster batch can exceed one chip);
+    the effective chunk size rounds up to the cluster grid so all
+    chunks share one shape. ``scheduler``/``read_bucket``/
+    ``band_bucket``: see plan_sweep. ``do_alignment_proposals`` enables
+    the in-kernel alignment-edits candidate gate (the driver default),
+    matching ``rifraf(..., do_alignment_proposals=True)``.
+
+    Returns the per-cluster results IN INPUT ORDER; with
+    ``return_stats`` also a SweepStats (per-bucket occupancy, padding
+    waste, and timing).
+    """
+    t_start = time.perf_counter()
+    G = len(clusters)
+    infos = _cluster_infos(clusters)
+    n_axis = mesh.devices.size if mesh is not None else 1
+    plans = plan_sweep(
+        clusters, scheduler=scheduler, read_bucket=read_bucket,
+        band_bucket=band_bucket, len_bucket=len_bucket,
+        cluster_chunk=cluster_chunk, n_axis=n_axis, infos=infos,
+    )
+    if G == 0:
+        stats = SweepStats(0, 0, 0, 0, 0, 0.0, 0, 0.0, [])
+        return ([], stats) if return_stats else []
+
+    executor = ChunkExecutor(
+        mesh=mesh, max_iters=max_iters, min_dist=min_dist,
+        bandwidth_pvalue=bandwidth_pvalue,
+        do_alignment_proposals=do_alignment_proposals,
+    )
+
+    tasks = [
+        (bi, plan, chunk)
+        for bi, plan in enumerate(plans)
+        for chunk in plan.chunks
+    ]
+    bucket_seconds = [0.0] * len(plans)
+    out: List[Optional[SweepResult]] = [None] * G
+
+    def pack(task):
+        bi, plan, idxs = task
+        return bi, executor.pack(plan, idxs, clusters, infos)
+
+    def run(arg):
+        bi, packed = arg
+        t0 = time.perf_counter()
+        handle = executor.run(packed)
+        bucket_seconds[bi] += time.perf_counter() - t0
+        return bi, handle
+
+    def collect(arg):
+        bi, handle = arg
+        t0 = time.perf_counter()
+        results = executor.collect(handle)
+        for ci, r in zip(handle[2], results):
+            out[ci] = r
         bucket_seconds[bi] += time.perf_counter() - t0
 
     pipeline_map(pack, run, collect, tasks)

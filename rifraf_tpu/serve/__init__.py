@@ -1,0 +1,40 @@
+"""rifraf_tpu.serve — online consensus with continuous micro-batching.
+
+An always-on counterpart to the offline ``parallel.sweep_clusters_sharded``
+sweep: requests (one read cluster each) are admitted through a bounded
+queue with per-request deadlines, micro-batched by the sweep scheduler's
+shape-bucket signature, and dispatched double-buffered through the SAME
+lru-cached compiled programs the offline sweep uses. See docs/serving.md.
+"""
+
+from .batcher import MicroBatcher
+from .errors import (
+    DeadlineExceededError,
+    EmptyClusterError,
+    OversizeError,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+)
+from .request import Request, Response, ServeConfig, encode_cluster
+from .server import ConsensusServer, submit_many
+from .stats import ServerStats
+from .worker import InternalError
+
+__all__ = [
+    "ConsensusServer",
+    "DeadlineExceededError",
+    "EmptyClusterError",
+    "InternalError",
+    "MicroBatcher",
+    "OversizeError",
+    "QueueFullError",
+    "Request",
+    "Response",
+    "ServeConfig",
+    "ServeError",
+    "ServerClosedError",
+    "ServerStats",
+    "encode_cluster",
+    "submit_many",
+]

@@ -1,0 +1,80 @@
+"""Continuous micro-batcher: per-shape-bucket pending queues + flush policy.
+
+Pure single-threaded logic (the server's batcher thread drives it with a
+monotonic clock), so the flush policy is testable with a fake clock and
+no JAX. Requests are grouped by their sweep-scheduler shape key
+(``parallel.sweep_sharded.bucket_key``); a bucket flushes when
+
+- it reaches ``max_batch`` requests (occupancy flush),
+- its OLDEST request has waited ``max_wait_ms`` (latency flush), or
+- any member's deadline is within ``deadline_margin_ms`` (deadline-risk
+  flush — dispatch now or miss it).
+
+gpuPairHMM and Endeavor (PAPERS.md) both find that this batching/padding
+policy, not kernel speed, dominates online throughput: max_wait trades
+tail latency for occupancy, and the shape-keyed grouping keeps padding
+waste at offline-sweep levels instead of pad-to-global-maxima.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .request import Request, ServeConfig
+
+
+class MicroBatcher:
+    """Pending-request store keyed by bucket signature."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self._pending: Dict[Tuple[int, int, int, int], List[Request]] = {}
+
+    def depth(self) -> int:
+        return sum(len(v) for v in self._pending.values())
+
+    def add(self, req: Request) -> Optional[List[Request]]:
+        """Admit one request; returns a full bucket's flush (in arrival
+        order) when this request filled it, else None."""
+        bucket = self._pending.setdefault(req.key, [])
+        bucket.append(req)
+        if len(bucket) >= self.config.max_batch:
+            return self._pending.pop(req.key)
+        return None
+
+    def due(self, now: float) -> List[List[Request]]:
+        """Buckets whose max-wait or deadline-risk timer has expired."""
+        max_wait = self.config.max_wait_ms / 1e3
+        margin = self.config.deadline_margin_ms / 1e3
+        flushes = []
+        for key in list(self._pending):
+            bucket = self._pending[key]
+            oldest_wait = now - bucket[0].t_submit
+            deadlines = [r.deadline for r in bucket if r.deadline is not None]
+            at_risk = deadlines and min(deadlines) - now <= margin
+            if oldest_wait >= max_wait or at_risk:
+                flushes.append(self._pending.pop(key))
+        return flushes
+
+    def next_due(self, now: float) -> Optional[float]:
+        """Seconds until the earliest pending timer fires (>= 0), or
+        None when nothing is pending — the batcher thread's poll
+        timeout."""
+        max_wait = self.config.max_wait_ms / 1e3
+        margin = self.config.deadline_margin_ms / 1e3
+        t_next = None
+        for bucket in self._pending.values():
+            t = bucket[0].t_submit + max_wait
+            for r in bucket:
+                if r.deadline is not None:
+                    t = min(t, r.deadline - margin)
+            t_next = t if t_next is None else min(t_next, t)
+        if t_next is None:
+            return None
+        return max(t_next - now, 0.0)
+
+    def drain(self) -> List[List[Request]]:
+        """Flush everything (shutdown)."""
+        out = list(self._pending.values())
+        self._pending.clear()
+        return out

@@ -1,0 +1,52 @@
+"""Typed request-level errors of the online consensus service.
+
+Every rejection the server can issue is a distinct exception type with a
+stable machine-readable ``code`` (the JSONL ``error`` field of the
+``rifraf-serve`` CLI). A rejected request NEVER stalls the micro-batch it
+would have joined: oversize and past-deadline requests are peeled off at
+admission or at pack time, and queue overflow is reported to the caller
+synchronously (backpressure) instead of blocking the submit.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(Exception):
+    """Base class for request-level serving errors."""
+
+    code = "serve_error"
+
+
+class QueueFullError(ServeError):
+    """The bounded admission queue is at capacity — the caller should
+    back off and retry (the backpressure signal)."""
+
+    code = "queue_full"
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline passed before it could be dispatched."""
+
+    code = "deadline_exceeded"
+
+
+class OversizeError(ServeError):
+    """The request exceeds the server's hard shape limits (``max_len`` /
+    ``max_reads``) and cannot be served at all. Requests that merely
+    exceed the BATCHED grid (``batch_max_len`` / ``batch_max_reads`` /
+    ``batch_max_band``) are not rejected — they fall back to the
+    per-cluster device loop as singletons."""
+
+    code = "oversize"
+
+
+class EmptyClusterError(ServeError):
+    """The request carries no reads."""
+
+    code = "empty_cluster"
+
+
+class ServerClosedError(ServeError):
+    """submit() after close()."""
+
+    code = "server_closed"

@@ -1,0 +1,158 @@
+"""Serving request/response types and the server configuration.
+
+A request is one cluster of ``ReadScores`` (the same unit as one
+``rifraf()`` call or one cluster of ``sweep_clusters_sharded``). The
+server's scope matches the sharded sweep: the no-reference device-loop
+configuration, bit-identical per request to
+``rifraf(..., batch_size=0, batch_fixed=False, device_loop="on")`` with
+the configured ``do_alignment_proposals`` (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..engine.params import DEFAULT_SCORES
+from ..models.errormodel import Scores
+from ..models.sequences import ReadScores, make_read_scores
+from ..utils.constants import CODON_LENGTH, decode_seq
+from .errors import ServeError
+
+
+@dataclass
+class ServeConfig:
+    """All serving tunables.
+
+    Shape routing reuses the sweep scheduler's grid
+    (``parallel.sweep_sharded.bucket_key``): requests are micro-batched
+    per ``(Npad, Lpad, Tmax, K0)`` signature, so the executable set
+    stays small and is SHARED with offline sweeps.
+    """
+
+    # --- admission / flush policy ---
+    # bounded admission queue: submit() raises QueueFullError beyond this
+    max_queue: int = 256
+    # flush a bucket as soon as it holds this many requests (also the
+    # cluster-axis padding ceiling of a micro-batch)
+    max_batch: int = 16
+    # ... or when its oldest request has waited this long
+    max_wait_ms: float = 20.0
+    # ... or when any member's deadline is within this margin (the time
+    # one dispatch+fetch is assumed to need; tune to your p95)
+    deadline_margin_ms: float = 50.0
+
+    # --- shape grid (must match offline sweeps to share executables) ---
+    read_bucket: int = 8
+    band_bucket: int = 16
+    len_bucket: int = 64
+
+    # --- graceful-degradation limits ---
+    # beyond these the request still runs, but as a per-cluster
+    # device-loop fallback (engine.device_loop via rifraf()) instead of
+    # joining a micro-batch
+    batch_max_reads: int = 64
+    batch_max_len: int = 2048
+    batch_max_band: int = 512
+    # beyond these the request is rejected outright (OversizeError)
+    max_reads: int = 4096
+    max_len: int = 65536
+
+    # --- engine parameters (the device-loop configuration) ---
+    max_iters: int = 100
+    min_dist: int = 5 * CODON_LENGTH
+    bandwidth_pvalue: float = 0.1
+    do_alignment_proposals: bool = False
+    # scores/bandwidth used by encode_cluster() and the singleton
+    # fallback path; clusters submitted as ready-made ReadScores must
+    # have been built with the SAME values or fallback results will not
+    # be bit-identical to batched ones
+    scores: Scores = DEFAULT_SCORES
+    bandwidth: int = 3 * CODON_LENGTH
+    # optional Mesh whose first axis shards the micro-batch cluster axis
+    mesh: Optional[object] = None
+
+
+def encode_cluster(
+    seqs: Sequence,
+    phreds: Optional[Sequence[np.ndarray]] = None,
+    error_log_ps: Optional[Sequence[np.ndarray]] = None,
+    config: Optional[ServeConfig] = None,
+) -> List[ReadScores]:
+    """Build a request cluster from raw sequences + quality scores using
+    the server's configured scores/bandwidth (so batched and fallback
+    paths agree). Accepts DNA strings or int8 code arrays."""
+    from ..utils.constants import encode_seq
+    from ..utils.phred import phred_to_log_p
+
+    config = config or ServeConfig()
+    if error_log_ps is None:
+        if phreds is None:
+            raise ValueError("provide phreds or error_log_ps")
+        error_log_ps = [phred_to_log_p(np.asarray(p, float)) for p in phreds]
+    return [
+        make_read_scores(
+            encode_seq(s) if isinstance(s, str) else np.asarray(s, np.int8),
+            lp, config.bandwidth, config.scores,
+        )
+        for s, lp in zip(seqs, error_log_ps)
+    ]
+
+
+@dataclass
+class Request:
+    """One admitted cluster plus its routing facts."""
+
+    id: str
+    cluster: List[ReadScores]
+    info: object  # parallel.sweep_sharded._ClusterInfo
+    key: Tuple[int, int, int, int]  # bucket_key routing signature
+    t_submit: float  # perf_counter at admission
+    deadline: Optional[float]  # absolute perf_counter time, or None
+    future: Future = field(default_factory=Future)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) > self.deadline
+
+
+@dataclass
+class Response:
+    """Terminal outcome of one request. ``ok`` is False for typed
+    rejections; ``error`` then holds the ServeError instance."""
+
+    id: str
+    ok: bool
+    consensus: Optional[np.ndarray] = None
+    score: Optional[float] = None
+    n_iters: int = 0
+    converged: bool = False
+    error: Optional[ServeError] = None
+    latency_s: float = 0.0
+    # "batched" (micro-batched sweep chunk), "fallback" (per-cluster
+    # device loop), or "rejected"
+    path: str = "batched"
+
+    def to_json_dict(self) -> dict:
+        """JSONL wire form (the rifraf-serve CLI response schema)."""
+        if not self.ok:
+            return {
+                "id": self.id, "ok": False,
+                "error": self.error.code if self.error else "serve_error",
+                "message": str(self.error) if self.error else "",
+                "latency_ms": round(self.latency_s * 1e3, 3),
+            }
+        return {
+            "id": self.id, "ok": True,
+            "consensus": decode_seq(self.consensus),
+            "score": float(self.score),
+            "n_iters": int(self.n_iters),
+            "converged": bool(self.converged),
+            "latency_ms": round(self.latency_s * 1e3, 3),
+            "path": self.path,
+        }

@@ -1,0 +1,289 @@
+"""ConsensusServer: the online consensus service front-end.
+
+Three threads cooperate:
+
+- the CALLER thread runs ``submit()``: admission checks (empty /
+  oversize / closed / queue-full) happen synchronously so typed errors
+  reach the caller immediately — backpressure is an exception, never a
+  block;
+- the BATCHER thread drains the admission queue into the MicroBatcher
+  and pushes due flushes (bucket-full / max-wait / deadline-risk) to
+  the worker's flush queue;
+- the WORKER thread (``worker.Worker.run_loop``) pipelines flushes
+  through the shared ChunkExecutor with double-buffered dispatch.
+
+``submit()`` returns a ``concurrent.futures.Future[Response]``;
+``submit_many()`` is the synchronous batch convenience that rides the
+backpressure signal instead of surfacing it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from queue import Empty, Full, Queue
+from typing import List, Optional, Sequence
+
+from ..models.sequences import ReadScores
+from .batcher import MicroBatcher
+from .errors import (
+    EmptyClusterError,
+    OversizeError,
+    QueueFullError,
+    ServeError,
+    ServerClosedError,
+)
+from .request import Request, Response, ServeConfig
+from .stats import ServerStats
+from .worker import STOP, Flush, Worker, respond_error
+
+_SHUTDOWN = object()  # admission-queue shutdown sentinel
+
+
+class ConsensusServer:
+    """Online consensus with continuous micro-batching and deadlines."""
+
+    def __init__(self, config: Optional[ServeConfig] = None,
+                 stats: Optional[ServerStats] = None, start: bool = True):
+        self.config = config or ServeConfig()
+        self.stats = stats or ServerStats()
+        self._admit_q: Queue = Queue(maxsize=self.config.max_queue)
+        self._flush_q: Queue = Queue()
+        self._batcher = MicroBatcher(self.config)
+        self._worker = Worker(self.config, self.stats)
+        self._ids = itertools.count()
+        self._closed = False
+        self._threads: List[threading.Thread] = []
+        if start:
+            self.start()
+
+    # ---- lifecycle ----
+
+    def start(self) -> "ConsensusServer":
+        if self._threads:
+            return self
+        bt = threading.Thread(target=self._batch_loop, daemon=True,
+                              name="rifraf-serve-batcher")
+        wt = threading.Thread(target=self._worker.run_loop,
+                              args=(self._flush_q,), daemon=True,
+                              name="rifraf-serve-worker")
+        self._threads = [bt, wt]
+        bt.start()
+        wt.start()
+        return self
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain pending work, then stop both threads. Requests already
+        admitted still complete; submit() afterwards raises
+        ServerClosedError."""
+        if self._closed:
+            return
+        self._closed = True
+        if not self._threads:
+            return
+        bt, wt = self._threads
+        self._admit_q.put(_SHUTDOWN)
+        bt.join(timeout)
+        self._flush_q.put(STOP)
+        wt.join(timeout)
+
+    def __enter__(self) -> "ConsensusServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- admission (caller thread) ----
+
+    def submit(self, cluster: Sequence[ReadScores], *,
+               request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None):
+        """Admit one cluster; returns Future[Response].
+
+        Raises synchronously: ServerClosedError, EmptyClusterError,
+        OversizeError (hard shape limits), QueueFullError (bounded
+        admission queue — the backpressure signal; back off and retry).
+        """
+        from ..parallel.sweep_sharded import bucket_key, cluster_info
+
+        if self._closed:
+            raise ServerClosedError("server is closed")
+        if not cluster:
+            raise EmptyClusterError("request carries no reads")
+        cfg = self.config
+        info = cluster_info(cluster)
+        if info.n_reads > cfg.max_reads or info.max_len > cfg.max_len:
+            raise OversizeError(
+                f"cluster shape ({info.n_reads} reads, max len "
+                f"{info.max_len}) exceeds hard limits "
+                f"({cfg.max_reads} reads, len {cfg.max_len})"
+            )
+        now = time.perf_counter()
+        req = Request(
+            id=request_id if request_id is not None
+            else f"r{next(self._ids)}",
+            cluster=list(cluster),
+            info=info,
+            key=bucket_key(info, cfg.read_bucket, cfg.band_bucket,
+                           cfg.len_bucket),
+            t_submit=now,
+            deadline=(now + deadline_ms / 1e3
+                      if deadline_ms is not None else None),
+        )
+        oversize_for_batch = (
+            info.n_reads > cfg.batch_max_reads
+            or info.max_len > cfg.batch_max_len
+            or info.entry_k > cfg.batch_max_band
+        )
+        kind = "fallback" if oversize_for_batch else "batch"
+        try:
+            self._admit_q.put_nowait((kind, req))
+        except Full:
+            self.stats.count("rejected_queue_full")
+            raise QueueFullError(
+                f"admission queue at capacity ({cfg.max_queue})"
+            ) from None
+        self.stats.count("submitted")
+        return req.future
+
+    # ---- batcher thread ----
+
+    def _batch_loop(self) -> None:
+        from .errors import DeadlineExceededError
+
+        while True:
+            timeout = self._batcher.next_due(time.perf_counter())
+            try:
+                item = self._admit_q.get(timeout=timeout)
+            except Empty:
+                item = None
+            if item is _SHUTDOWN:
+                # drain: everything already admitted still runs
+                while True:
+                    try:
+                        kind, req = self._admit_q.get_nowait()
+                    except Empty:
+                        break
+                    self._route(kind, req)
+                for bucket in self._batcher.drain():
+                    self._flush("batch", bucket, "flush_drain")
+                return
+            if item is not None:
+                kind, req = item
+                if req.expired():
+                    respond_error(req, DeadlineExceededError(
+                        f"request {req.id}: deadline passed in queue"
+                    ), self.stats, "rejected_deadline")
+                else:
+                    self._route(kind, req)
+            for bucket in self._batcher.due(time.perf_counter()):
+                self._flush("batch", bucket, "flush_timer")
+
+    def _route(self, kind: str, req: Request) -> None:
+        if kind == "fallback":
+            self._flush("fallback", [req], "flush_fallback")
+            return
+        full = self._batcher.add(req)
+        if full is not None:
+            self._flush("batch", full, "flush_full")
+
+    def _flush(self, kind: str, requests: List[Request],
+               counter: str) -> None:
+        self.stats.count(counter)
+        self._flush_q.put(Flush(kind, requests))
+
+    # ---- warmup / observability ----
+
+    def warmup(self, example_clusters: Sequence[Sequence[ReadScores]],
+               batch_sizes: Sequence[int] = (1,)) -> int:
+        """Pre-trace the bucket-grid executables before taking traffic.
+
+        Groups the examples by routing signature and runs one synthetic
+        micro-batch per (signature, padded batch size) through the
+        ChunkExecutor — with the fingerprinted XLA compilation cache
+        enabled, so a restarted server rehydrates from disk instead of
+        recompiling. Returns the number of executables exercised.
+        """
+        from ..engine.driver import _enable_compilation_cache
+        from ..parallel.sweep_sharded import bucket_key, cluster_info
+
+        _enable_compilation_cache()
+        cfg = self.config
+        by_key = {}
+        for c in example_clusters:
+            info = cluster_info(c)
+            key = bucket_key(info, cfg.read_bucket, cfg.band_bucket,
+                             cfg.len_bucket)
+            by_key.setdefault(key, (list(c), info))
+        n_traced = 0
+        with self.stats.timers.time("serve_warmup"):
+            for key, (c, info) in by_key.items():
+                gps = sorted({
+                    self._worker.plan_for(key, min(n, cfg.max_batch)).gp
+                    for n in batch_sizes
+                })
+                for gp in gps:
+                    plan = self._worker.plan_for(key, gp)
+                    packed = self._worker.executor.pack(
+                        plan, range(gp), [c] * gp, [info] * gp)
+                    self._worker.executor.collect(
+                        self._worker.executor.run(packed))
+                    n_traced += 1
+        self.stats.count("warmup_programs", n_traced)
+        return n_traced
+
+    def queue_depth(self) -> int:
+        return self._admit_q.qsize() + self._batcher.depth()
+
+    def snapshot(self) -> dict:
+        return self.stats.snapshot(queue_depth=self.queue_depth())
+
+
+def submit_many(
+    clusters: Sequence[Sequence[ReadScores]],
+    config: Optional[ServeConfig] = None,
+    server: Optional[ConsensusServer] = None,
+    deadline_ms: Optional[float] = None,
+) -> List[Response]:
+    """Synchronously serve a list of clusters; returns Responses aligned
+    with the input order.
+
+    Rides the backpressure protocol for the caller: on QueueFullError it
+    waits for the oldest in-flight request to finish and retries. Other
+    admission rejections (oversize, empty) become ``ok=False``
+    Responses so alignment with the input list is preserved.
+    """
+    own = server is None
+    srv = server if server is not None else ConsensusServer(config)
+    try:
+        slots: List[object] = [None] * len(clusters)
+        inflight: deque = deque()
+        for i, c in enumerate(clusters):
+            while True:
+                try:
+                    fut = srv.submit(c, request_id=f"c{i}",
+                                     deadline_ms=deadline_ms)
+                    slots[i] = fut
+                    inflight.append(fut)
+                    break
+                except QueueFullError:
+                    if inflight:
+                        inflight.popleft().result()
+                    else:
+                        time.sleep(1e-3)
+                except ServeError as e:
+                    slots[i] = e
+                    break
+        out: List[Response] = []
+        for i, s in enumerate(slots):
+            if isinstance(s, ServeError):
+                out.append(Response(id=f"c{i}", ok=False, error=s,
+                                    path="rejected"))
+            else:
+                out.append(s.result())
+        return out
+    finally:
+        if own:
+            srv.close()

@@ -1,0 +1,101 @@
+"""Serving metrics: counters, latency percentiles, batch occupancy.
+
+Built on utils.timers.Timers for the wall-clock sections (pack /
+dispatch / fetch / fallback / warmup) and a bounded latency reservoir
+for the percentiles; ``snapshot()`` is the JSON-serializable export the
+CLI prints and bench.py emits (Timers.to_dict does the timer half).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.timers import Timers
+
+# newest-N latency reservoir: enough for stable p99 at bench scale
+# without unbounded growth in a long-lived server
+LATENCY_WINDOW = 65536
+
+
+class ServerStats:
+    """Thread-safe rollup of everything the server observes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.timers = Timers()
+        self._counters: Dict[str, int] = {}
+        self._latencies = deque(maxlen=LATENCY_WINDOW)
+        # micro-batch shape accounting (the SweepStats analogue)
+        self._batches = 0
+        self._batched_requests = 0
+        self._padded_slots = 0
+        self._useful_cells = 0
+        self._padded_cells = 0
+        self._declines: Dict[str, int] = {}
+
+    def count(self, name: str, k: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + k
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(seconds)
+
+    def note_batch(self, n_real: int, gp: int, useful_cells: int,
+                   padded_cells: int) -> None:
+        """One dispatched micro-batch: ``n_real`` live requests padded
+        to a ``gp``-cluster chunk of ``padded_cells`` read-lane cells."""
+        with self._lock:
+            self._batches += 1
+            self._batched_requests += n_real
+            self._padded_slots += gp
+            self._useful_cells += useful_cells
+            self._padded_cells += padded_cells
+
+    def note_declines(self, declines) -> None:
+        """Fold a fallback run's RifrafResult.metadata["declines"] into
+        per-reason counters (the server's reject/fallback observability
+        without log parsing)."""
+        with self._lock:
+            for d in declines or ():
+                key = f"{d['stage']}: {d['reason']}"
+                self._declines[key] = self._declines.get(key, 0) + 1
+
+    def _percentiles(self):
+        lat = np.asarray(self._latencies, float)
+        if lat.size == 0:
+            return {}
+        p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+        return {
+            "p50": round(float(p50) * 1e3, 3),
+            "p95": round(float(p95) * 1e3, 3),
+            "p99": round(float(p99) * 1e3, 3),
+            "mean": round(float(lat.mean()) * 1e3, 3),
+            "max": round(float(lat.max()) * 1e3, 3),
+            "n": int(lat.size),
+        }
+
+    def snapshot(self, queue_depth: Optional[int] = None) -> dict:
+        """JSON-serializable state: counters, occupancy, padding waste,
+        latency percentiles (ms), decline reasons, timer sections."""
+        with self._lock:
+            out = {
+                "counters": dict(self._counters),
+                "batches": self._batches,
+                "batch_occupancy": round(
+                    self._batched_requests / self._padded_slots, 4
+                ) if self._padded_slots else None,
+                "padding_waste": round(
+                    1.0 - self._useful_cells / self._padded_cells, 4
+                ) if self._padded_cells else None,
+                "latency_ms": self._percentiles(),
+                "declines": dict(self._declines),
+                "timers": self.timers.to_dict(),
+            }
+        if queue_depth is not None:
+            out["queue_depth"] = queue_depth
+        return out

@@ -1,0 +1,215 @@
+"""The dispatch worker: flushed micro-batches -> device -> responses.
+
+Flushes stream through ``parallel.cluster.pipeline_map`` in bursts, so
+the worker inherits the offline sweep's double-buffering: host packing
+of batch k+1 overlaps device execution of batch k, and batch k's
+blocking fetch happens only after k+1 has been dispatched. Per-job
+error isolation (``on_error="return"``) means one failing micro-batch
+fails ONLY its own requests — the batches behind it keep flowing.
+
+Two flush kinds:
+
+- ``"batch"``: a same-bucket micro-batch, packed/dispatched through the
+  shared ChunkExecutor (the sweep's lru-cached program factories, so a
+  serving signature and an offline sweep bucket share one executable);
+- ``"fallback"``: an oversize singleton, run through the per-cluster
+  device loop (``rifraf()`` in the sweep-equivalent configuration) so a
+  3 kb outlier degrades gracefully instead of dragging a whole bucket's
+  padded shape up with it.
+"""
+
+from __future__ import annotations
+
+import time
+from queue import Empty, Queue
+from typing import List, NamedTuple
+
+from ..parallel.cluster import PipelineJobError, pipeline_map
+from ..parallel.sweep_sharded import BucketPlan, ChunkExecutor, SweepResult
+from ..utils.shapes import bucket as _bucket
+from ..utils.shapes import pow2_bucket
+from .errors import DeadlineExceededError, ServeError
+from .request import Request, Response, ServeConfig
+from .stats import ServerStats
+
+STOP = object()  # flush-queue shutdown sentinel
+
+
+class Flush(NamedTuple):
+    kind: str  # "batch" | "fallback"
+    requests: List[Request]
+
+
+class InternalError(ServeError):
+    """A micro-batch failed in pack/dispatch/fetch; carries the cause."""
+
+    code = "internal"
+
+
+def respond_error(req: Request, err: ServeError, stats: ServerStats,
+                  counter: str) -> None:
+    if req.future.done():
+        return
+    lat = time.perf_counter() - req.t_submit
+    stats.count(counter)
+    req.future.set_result(Response(
+        id=req.id, ok=False, error=err, latency_s=lat, path="rejected",
+    ))
+
+
+class Worker:
+    """Owns the ChunkExecutor and the flush-queue consumer loop."""
+
+    def __init__(self, config: ServeConfig, stats: ServerStats):
+        self.config = config
+        self.stats = stats
+        self.executor = ChunkExecutor(
+            mesh=config.mesh,
+            max_iters=config.max_iters,
+            min_dist=config.min_dist,
+            bandwidth_pvalue=config.bandwidth_pvalue,
+            do_alignment_proposals=config.do_alignment_proposals,
+        )
+
+    # ---- pipeline stages (pack on the background thread, run/collect
+    # on the worker thread) ----
+
+    def plan_for(self, key, n: int) -> BucketPlan:
+        """One-chunk plan for a micro-batch of n clusters: the cluster
+        axis rounds to the next power of two (and the mesh axis) so the
+        number of distinct compiled batch shapes stays logarithmic."""
+        mesh = self.config.mesh
+        n_axis = mesh.devices.size if mesh is not None else 1
+        gp = _bucket(pow2_bucket(n), max(n_axis, 1))
+        return BucketPlan(key=key, band=self.config.band_bucket, gp=gp,
+                          chunks=[list(range(n))])
+
+    def _pack(self, flush: Flush):
+        if flush.kind != "batch":
+            return flush, None
+        now = time.perf_counter()
+        live = []
+        for r in flush.requests:
+            if r.expired(now):
+                respond_error(r, DeadlineExceededError(
+                    f"request {r.id}: deadline passed before dispatch"
+                ), self.stats, "rejected_deadline")
+            else:
+                live.append(r)
+        if not live:
+            return Flush("batch", []), None
+        with self.stats.timers.time("serve_pack"):
+            plan = self.plan_for(live[0].key, len(live))
+            packed = self.executor.pack(
+                plan, range(len(live)), [r.cluster for r in live],
+                [r.info for r in live],
+            )
+        return Flush("batch", live), (plan, packed)
+
+    def _run(self, arg):
+        flush, staged = arg
+        if flush.kind == "fallback":
+            return flush, self._run_fallback(flush.requests[0])
+        if staged is None:
+            return flush, None
+        plan, packed = staged
+        with self.stats.timers.time("serve_dispatch"):
+            handle = self.executor.run(packed)
+        N, L, _, _ = plan.key
+        self.stats.note_batch(
+            n_real=len(flush.requests), gp=plan.gp,
+            useful_cells=sum(r.info.useful for r in flush.requests),
+            padded_cells=plan.gp * N * L,
+        )
+        return flush, handle
+
+    def _collect(self, arg) -> int:
+        flush, handle = arg
+        if handle is None:
+            return 0
+        if flush.kind == "fallback":
+            self._respond_ok(flush.requests[0], handle, "fallback")
+            return 1
+        with self.stats.timers.time("serve_fetch"):
+            results = self.executor.collect(handle)
+        for req, res in zip(flush.requests, results):
+            self._respond_ok(req, res, "batched")
+        return len(flush.requests)
+
+    # ---- per-request terminals ----
+
+    def _respond_ok(self, req: Request, res: SweepResult,
+                    path: str) -> None:
+        if req.future.done():
+            return
+        lat = time.perf_counter() - req.t_submit
+        self.stats.observe_latency(lat)
+        self.stats.count("completed")
+        req.future.set_result(Response(
+            id=req.id, ok=True, consensus=res.consensus, score=res.score,
+            n_iters=res.n_iters, converged=res.converged, latency_s=lat,
+            path=path,
+        ))
+
+    def _run_fallback(self, req: Request) -> SweepResult:
+        """PR 1 per-cluster device loop, in the batched path's exact
+        algorithmic configuration (full batch, all-edits candidates or
+        the edits gate) so oversize singletons stay bit-identical to
+        what a bigger bucket grid would have produced."""
+        from ..engine.driver import rifraf
+        from ..engine.params import RifrafParams
+
+        cfg = self.config
+        with self.stats.timers.time("serve_fallback"):
+            result = rifraf(
+                [r.seq for r in req.cluster],
+                error_log_ps=[r.error_log_p for r in req.cluster],
+                params=RifrafParams(
+                    batch_size=0, batch_fixed=False,
+                    do_alignment_proposals=cfg.do_alignment_proposals,
+                    max_iters=cfg.max_iters, min_dist=cfg.min_dist,
+                    bandwidth_pvalue=cfg.bandwidth_pvalue,
+                    bandwidth=cfg.bandwidth, scores=cfg.scores,
+                ),
+            )
+        self.stats.count("fallback")
+        if result.metadata:
+            self.stats.note_declines(result.metadata.get("declines"))
+        return SweepResult(
+            consensus=result.consensus,
+            score=float(result.state.score),
+            n_iters=int(result.state.stage_iterations.sum()),
+            converged=bool(result.state.converged),
+        )
+
+    def _fail_flush(self, flush: Flush, err: PipelineJobError) -> None:
+        wrapped = InternalError(str(err))
+        wrapped.__cause__ = err.__cause__
+        for r in flush.requests:
+            respond_error(r, wrapped, self.stats, "failed_internal")
+
+    # ---- the consumer loop (one thread) ----
+
+    def run_loop(self, flush_q: Queue) -> None:
+        stop = False
+        while not stop:
+            item = flush_q.get()
+            if item is STOP:
+                break
+            burst: List[Flush] = [item]
+            while True:
+                try:
+                    nxt = flush_q.get_nowait()
+                except Empty:
+                    break
+                if nxt is STOP:
+                    stop = True
+                    break
+                burst.append(nxt)
+            results = pipeline_map(
+                self._pack, self._run, self._collect, burst,
+                on_error="return",
+            )
+            for r in results:
+                if isinstance(r, PipelineJobError):
+                    self._fail_flush(burst[r.job_index], r)

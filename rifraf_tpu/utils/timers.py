@@ -43,3 +43,15 @@ class Timers:
         ):
             lines.append(f"  {name:28s} {n:6d} calls  {s*1e3:10.1f} ms")
         return "\n".join(lines)
+
+    def to_dict(self) -> Dict[str, Dict[str, float]]:
+        """JSON-serializable export: name -> {"calls", "seconds"}, sorted
+        by descending total time like summary(). The serving stats
+        surface (serve.stats.ServerStats) and bench.py emit this instead
+        of reaching into .data."""
+        return {
+            name: {"calls": n, "seconds": round(s, 6)}
+            for name, (n, s) in sorted(
+                self.data.items(), key=lambda kv: -kv[1][1]
+            )
+        }

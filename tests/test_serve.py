@@ -1,0 +1,303 @@
+"""Online consensus service (rifraf_tpu.serve): admission, flush
+policy, typed rejections, fallback equality, and (slow) end-to-end
+bit-identity of served results vs the per-cluster driver."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from rifraf_tpu import serve
+from rifraf_tpu.engine.driver import rifraf
+from rifraf_tpu.engine.params import RifrafParams
+from rifraf_tpu.models.errormodel import ErrorModel
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.parallel.cluster import PipelineJobError, pipeline_map
+from rifraf_tpu.serve.batcher import MicroBatcher
+from rifraf_tpu.serve.request import Request
+from rifraf_tpu.sim.sample import sample_sequences
+from rifraf_tpu.utils.phred import phred_to_log_p
+from rifraf_tpu.utils.timers import Timers
+
+SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+
+
+def _cluster(nseqs=3, length=30, seed=0):
+    rng = np.random.default_rng(seed)
+    params = RifrafParams()
+    _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=nseqs, length=length, error_rate=0.02, rng=rng,
+        seq_errors=SEQ_ERRORS,
+    )
+    return [
+        make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                         params.bandwidth, params.scores)
+        for s, p in zip(seqs, phreds)
+    ]
+
+
+def _ref(cluster, do_alignment_proposals=False, device_loop=None):
+    kw = {} if device_loop is None else {"device_loop": device_loop}
+    return rifraf(
+        [r.seq for r in cluster],
+        error_log_ps=[r.error_log_p for r in cluster],
+        params=RifrafParams(batch_size=0, batch_fixed=False,
+                            do_alignment_proposals=do_alignment_proposals,
+                            **kw),
+    )
+
+
+# ---------------------------------------------------------------- satellites
+
+
+def test_timers_to_dict():
+    t = Timers()
+    with t.time("outer"):
+        with t.time("inner"):
+            pass
+    with t.time("inner"):
+        pass
+    d = t.to_dict()
+    assert set(d) == {"outer", "inner"}
+    assert d["inner"]["calls"] == 2
+    assert d["outer"]["seconds"] >= 0.0
+    json.dumps(d)  # JSON-serializable as exported
+
+
+def test_driver_declines_metadata():
+    """Config-level device-loop declines surface as structured
+    metadata entries, not just verbose log lines."""
+    c = _cluster(seed=3)
+    res = rifraf(
+        [r.seq for r in c], error_log_ps=[r.error_log_p for r in c],
+        params=RifrafParams(batch_size=0, batch_fixed=False,
+                            min_dist=1, device_loop="on"),
+    )
+    declines = res.metadata["declines"]
+    assert declines, "min_dist=1 must decline the device loop"
+    assert all(set(d) == {"stage", "reason"} for d in declines)
+    assert any("min_dist" in d["reason"] for d in declines)
+
+
+def test_pipeline_map_on_error_return_isolates_jobs():
+    def pack(x):
+        return x
+
+    def run(x):
+        if x == 1:
+            raise ValueError("boom at run")
+        return x * 10
+
+    def collect(x):
+        if x == 20:
+            raise KeyError("boom at collect")
+        return x + 1
+
+    out = pipeline_map(pack, run, collect, [0, 1, 2, 3],
+                       on_error="return")
+    assert out[0] == 1 and out[3] == 31
+    assert isinstance(out[1], PipelineJobError)
+    assert out[1].job_index == 1 and out[1].stage == "run"
+    assert isinstance(out[1].__cause__, ValueError)
+    assert isinstance(out[2], PipelineJobError)
+    assert out[2].job_index == 2 and out[2].stage == "collect"
+
+
+# ------------------------------------------------------------- micro-batcher
+
+
+def _fake_request(rid, key, t_submit, deadline=None):
+    return Request(id=rid, cluster=[], info=None, key=key,
+                   t_submit=t_submit, deadline=deadline)
+
+
+def test_microbatcher_flush_policy_fake_clock():
+    cfg = serve.ServeConfig(max_batch=3, max_wait_ms=20.0,
+                            deadline_margin_ms=50.0)
+    b = MicroBatcher(cfg)
+    ka, kb = (8, 64, 64, 16), (16, 64, 64, 16)
+
+    # occupancy flush: the 3rd same-key request returns the bucket
+    assert b.add(_fake_request("a0", ka, 0.0)) is None
+    assert b.add(_fake_request("b0", kb, 0.0)) is None
+    assert b.add(_fake_request("a1", ka, 0.001)) is None
+    full = b.add(_fake_request("a2", ka, 0.002))
+    assert [r.id for r in full] == ["a0", "a1", "a2"]
+    assert b.depth() == 1  # kb still pending
+
+    # max-wait flush: due() pops kb once its oldest waited 20 ms
+    assert b.due(now=0.010) == []
+    assert b.next_due(now=0.010) == pytest.approx(0.010)
+    (timed,) = b.due(now=0.021)
+    assert [r.id for r in timed] == ["b0"]
+    assert b.depth() == 0
+
+    # deadline-risk flush: a fresh request whose deadline is inside the
+    # margin flushes immediately even though max_wait hasn't elapsed
+    b.add(_fake_request("c0", ka, 1.0, deadline=1.040))
+    (risk,) = b.due(now=1.0)
+    assert [r.id for r in risk] == ["c0"]
+
+    # drain returns everything left
+    b.add(_fake_request("d0", ka, 2.0))
+    b.add(_fake_request("d1", kb, 2.0))
+    assert sorted(r.id for f in b.drain() for r in f) == ["d0", "d1"]
+    assert b.depth() == 0 and b.next_due(2.0) is None
+
+
+# ------------------------------------------------- admission / typed errors
+
+
+def test_queue_full_rejects_instead_of_blocking():
+    cfg = serve.ServeConfig(max_queue=2)
+    srv = serve.ConsensusServer(cfg, start=False)  # nothing consumes
+    c = _cluster()
+    t0 = time.perf_counter()
+    srv.submit(c)
+    srv.submit(c)
+    with pytest.raises(serve.QueueFullError) as ei:
+        srv.submit(c)
+    assert time.perf_counter() - t0 < 5.0  # rejected, not blocked
+    assert ei.value.code == "queue_full"
+    assert srv.snapshot()["counters"]["rejected_queue_full"] == 1
+
+
+def test_expired_deadline_yields_typed_error():
+    srv = serve.ConsensusServer(serve.ServeConfig(), start=False)
+    fut = srv.submit(_cluster(), deadline_ms=1.0)
+    time.sleep(0.02)
+    srv.start()  # batcher now sees an already-expired request
+    resp = fut.result(timeout=30)
+    srv.close()
+    assert not resp.ok
+    assert resp.path == "rejected"
+    assert isinstance(resp.error, serve.DeadlineExceededError)
+    assert resp.to_json_dict()["error"] == "deadline_exceeded"
+
+
+def test_hard_rejects_are_synchronous_and_typed():
+    cfg = serve.ServeConfig(max_reads=4, max_len=64)
+    srv = serve.ConsensusServer(cfg, start=False)
+    with pytest.raises(serve.EmptyClusterError):
+        srv.submit([])
+    with pytest.raises(serve.OversizeError):
+        srv.submit(_cluster(nseqs=6))  # > max_reads
+    with pytest.raises(serve.OversizeError):
+        srv.submit(_cluster(length=100))  # > max_len
+    srv._closed = True
+    with pytest.raises(serve.ServerClosedError):
+        srv.submit(_cluster())
+
+
+def test_response_wire_form():
+    ok = serve.Response(id="x", ok=True,
+                        consensus=np.array([0, 1, 2, 3], np.int8),
+                        score=-1.5, n_iters=2, converged=True,
+                        latency_s=0.0123)
+    d = ok.to_json_dict()
+    assert d == {"id": "x", "ok": True, "consensus": "ACGT",
+                 "score": -1.5, "n_iters": 2, "converged": True,
+                 "latency_ms": 12.3, "path": "batched"}
+    bad = serve.Response(id="y", ok=False,
+                         error=serve.OversizeError("too big"),
+                         path="rejected")
+    d = bad.to_json_dict()
+    assert d["ok"] is False and d["error"] == "oversize"
+    json.dumps(d)
+
+
+def test_encode_cluster_requires_quality():
+    with pytest.raises(ValueError):
+        serve.encode_cluster(["ACGT"])
+
+
+# ------------------------------------------------------------ fallback path
+
+
+def test_oversize_for_batch_falls_back_to_device_loop():
+    """Requests over the batched grid limits run as per-cluster
+    fallbacks and must equal the direct rifraf() run in the same
+    configuration."""
+    cfg = serve.ServeConfig(batch_max_reads=1, max_iters=100)
+    clusters = [_cluster(seed=s) for s in (1, 2)]
+    with serve.ConsensusServer(cfg) as srv:
+        resps = [srv.submit(c).result(timeout=120) for c in clusters]
+        snap = srv.snapshot()
+    assert snap["counters"]["fallback"] == 2
+    assert snap["latency_ms"]["n"] == 2
+    for c, r in zip(clusters, resps):
+        assert r.ok and r.path == "fallback"
+        ref = _ref(c)
+        assert np.array_equal(r.consensus, ref.consensus)
+        assert np.isclose(r.score, float(ref.state.score), rtol=1e-6)
+        assert r.n_iters == int(ref.state.stage_iterations.sum())
+
+
+def test_submit_many_keeps_input_alignment_through_rejects():
+    cfg = serve.ServeConfig(batch_max_reads=1)  # all-fallback: no compiles
+    clusters = [_cluster(seed=1), [], _cluster(seed=2)]
+    resps = serve.submit_many(clusters, config=cfg)
+    assert len(resps) == 3
+    assert resps[0].ok and resps[2].ok
+    assert not resps[1].ok
+    assert isinstance(resps[1].error, serve.EmptyClusterError)
+    assert [r.id for r in resps] == ["c0", "c1", "c2"]
+
+
+# ------------------------------------------------------- end-to-end (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dap", [False, True])
+def test_served_results_bit_identical_to_driver(dap):
+    """A shuffled heterogeneous workload served through warmed
+    micro-batches must be bit-identical, per request, to the
+    per-cluster device-loop driver — for both candidate algorithms."""
+    rng = np.random.default_rng(7)
+    pool = []
+    for nseqs, length, seed in [(4, 50, 1), (6, 90, 2), (5, 50, 3),
+                                (6, 92, 4), (4, 52, 5), (3, 30, 6)]:
+        pool.append(_cluster(nseqs=nseqs, length=length, seed=seed))
+    shuffled = [pool[i] for i in rng.permutation(len(pool))]
+    cfg = serve.ServeConfig(max_batch=4, max_wait_ms=5.0,
+                            do_alignment_proposals=dap)
+    with serve.ConsensusServer(cfg) as srv:
+        assert srv.warmup(shuffled, batch_sizes=(1, 4)) > 0
+        resps = serve.submit_many(shuffled, server=srv)
+        snap = srv.snapshot()
+    assert snap["batches"] >= 1 and snap["batch_occupancy"] > 0
+    for c, r in zip(shuffled, resps):
+        assert r.ok, r.error
+        ref = _ref(c, do_alignment_proposals=dap, device_loop="on")
+        assert np.array_equal(r.consensus, ref.consensus)
+        assert np.isclose(r.score, float(ref.state.score), rtol=1e-6)
+        assert r.n_iters == int(ref.state.stage_iterations.sum())
+        assert r.converged == bool(ref.state.converged)
+
+
+@pytest.mark.slow
+def test_cli_serve_watch_once(tmp_path):
+    from rifraf_tpu.cli.serve import main as serve_main
+
+    seqs = ["ACGTACGTACGTACGTACGTACGT"] * 3
+    reqs = [
+        {"id": f"q{i}", "seqs": seqs,
+         "phreds": [[20] * len(s) for s in seqs]}
+        for i in range(2)
+    ]
+    reqs.append({"id": "bad", "seqs": ["ACGT"]})  # no quality info
+    (tmp_path / "in.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in reqs) + "\n")
+    rc = serve_main(["--watch", str(tmp_path), "--watch-once",
+                     "--max-iters", "8", "--max-batch", "2"])
+    assert rc == 0
+    lines = [json.loads(l) for l in
+             (tmp_path / "in.out.jsonl").read_text().splitlines()]
+    by_id = {d["id"]: d for d in lines}
+    assert by_id["q0"]["ok"] and by_id["q1"]["ok"]
+    assert by_id["q0"]["consensus"] == by_id["q1"]["consensus"]
+    assert not by_id["bad"]["ok"]
+    assert by_id["bad"]["error"] == "bad_request"

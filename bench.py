@@ -92,7 +92,7 @@ def build_e2e_problem(tlen=TLEN, n_reads=N_READS, seed=0, error_rate=0.01):
 
 
 def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
-            device_loop=None):
+            device_loop=None, do_score=False):
     """One full consensus; returns (wall_seconds, result)."""
     from rifraf_tpu.engine.driver import rifraf
     from rifraf_tpu.engine.params import RifrafParams
@@ -123,6 +123,8 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
         kw["bandwidth"] = bandwidth
     if device_loop is not None:
         kw["device_loop"] = device_loop
+    if do_score:
+        kw["do_score"] = True
     params = RifrafParams(max_iters=max_iters, **kw)
     t0 = time.perf_counter()
     result = rifraf(seqs, phreds=phreds, params=params)
@@ -131,14 +133,14 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
 
 def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
                 max_iters=100, verbose=False, ref_default=False,
-                device_loop=None):
+                device_loop=None, do_score=False):
     template, seqs, phreds = build_e2e_problem(tlen, n_reads)
     walls = []
     result = None
     for i in range(n_timed + 1):  # first run compiles
         wall, result = run_e2e(seqs, phreds, bandwidth=bandwidth,
                                max_iters=max_iters, ref_default=ref_default,
-                               device_loop=device_loop)
+                               device_loop=device_loop, do_score=do_score)
         if verbose:
             label = "compile+run" if i == 0 else "warm"
             print(f"  run {i}: {wall:.2f}s ({label})", file=sys.stderr)
@@ -154,6 +156,37 @@ def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
 # replaces them with one dispatch + one fetch per STAGE)
 _DISPATCH_TIMERS = ("fused_dispatch", "packed_fetch", "moves_fetch",
                     "adapt_dispatch", "adapt_fetch")
+
+
+def roofline_stats(result):
+    """Measured fraction of the HBM roof for a finished run's fused
+    Pallas dispatches: modelled bytes-moved per dispatch (the block
+    planner records one utils.roofline entry per fused_step call)
+    against the host-observed dispatch + packed-fetch wall time of the
+    same sections. None when the run made no recorded Pallas dispatches
+    (CPU/XLA backend, or a fully device-resident stage loop)."""
+    from rifraf_tpu.utils import roofline
+
+    recs = [r for r in roofline.snapshot() if r["kernel"] == "fused_step"]
+    data = result.timers.data
+    if not recs or "fused_dispatch" not in data:
+        return None
+    calls, seconds = data["fused_dispatch"]
+    seconds += data.get("packed_fetch", (0, 0.0))[1]
+    mean_bytes = sum(r["model_bytes"] for r in recs) / len(recs)
+    per_dispatch = seconds / max(calls, 1)
+    u = roofline.utilization(mean_bytes, per_dispatch)
+    r = recs[-1]
+    return {
+        "dispatches": calls,
+        "model_gb_per_dispatch": round(mean_bytes / 1e9, 3),
+        "seconds_per_dispatch": round(per_dispatch, 4),
+        "gbps": round(u["gbps"], 1),
+        "pct_hbm_roof": round(u["pct_hbm"], 1),
+        "hbm_roof_gbps": roofline.HBM_GBPS,
+        "plan": {"T1p": r["T1p"], "K": r["K"], "C": r["C"],
+                 "Npad": r["Npad"]},
+    }
 
 
 def host_dispatch_stats(result, walls):
@@ -235,7 +268,10 @@ def _northstar_mode():
         ("2048x1kb", 1000, 2048, None, 2),
         ("10kbx512_band64", 10000, 512, 64, 1),
     ):
-        walls, n_iters, recovered, _ = measure_e2e(
+        from rifraf_tpu.utils import roofline as _roofline
+
+        _roofline.clear()
+        walls, n_iters, recovered, res = measure_e2e(
             tlen, n_reads, bandwidth=bandwidth, n_timed=n_timed, verbose=True
         )
         wall = min(walls)
@@ -247,6 +283,7 @@ def _northstar_mode():
             "iterations": n_iters,
             "seconds_per_iteration": round(wall / max(n_iters, 1), 4),
             "template_recovered": recovered,
+            "roofline": roofline_stats(res),
         }))
 
 
@@ -564,7 +601,10 @@ def main():
     if "--quick" not in sys.argv:
         # driver-capture the north-star config (the >=50x target is
         # DEFINED on 2048 x 1 kb — BASELINE.json) in the same JSON line
-        walls_ns, it_ns, rec_ns, _ = measure_e2e(
+        from rifraf_tpu.utils import roofline as _roofline
+
+        _roofline.clear()
+        walls_ns, it_ns, rec_ns, res_ns = measure_e2e(
             tlen=1000, n_reads=2048, n_timed=2, verbose=verbose
         )
         ns = min(walls_ns)
@@ -575,6 +615,31 @@ def main():
             "cpu_baseline_s": CPU_NORTHSTAR_SECONDS,
             "iterations": it_ns,
             "template_recovered": rec_ns,
+            "roofline": roofline_stats(res_ns),
+        }
+        # do_score=True at the north-star shape: the quality-estimation
+        # tail (SCORE-stage realign with the on-core stats kernel + move
+        # fetch, dense-table quality readout, pileup probabilities) on
+        # top of the consensus loop — the sections the round-6 stats
+        # kernel and the vectorized estimate_probs readout target
+        _roofline.clear()
+        walls_ds, it_ds, rec_ds, res_ds = measure_e2e(
+            tlen=1000, n_reads=2048, n_timed=1, verbose=verbose,
+            do_score=True,
+        )
+        td = res_ds.timers.to_dict()
+        out["do_score_2048x1kb"] = {
+            "value": round(min(walls_ds), 3),
+            "runs_s": [round(w, 3) for w in walls_ds],
+            "iterations": it_ds,
+            "template_recovered": rec_ds,
+            "score_sections_s": {
+                k: td[k]["seconds"]
+                for k in ("realign_rescore", "estimate_probs",
+                          "moves_fetch", "tables_readout")
+                if k in td
+            },
+            "roofline": roofline_stats(res_ds),
         }
         # and the REFERENCE-DEFAULT parameter set (what cli/consensus.py
         # runs): fixed top-5 INIT batch, batch growth, alignment proposals

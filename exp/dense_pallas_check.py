@@ -48,7 +48,9 @@ geom = align_jax.batch_geometry(batch, tlen)
 K = fill_pallas.uniform_band_height(np.asarray(geom.offset), np.asarray(geom.nd))
 Tmax = ((tlen + 63) // 64) * 64
 T1p = Tmax + 64
-C = dense_pallas.pick_dense_cols(T1p, K)
+from rifraf_tpu.utils.shapes import plan_cols
+
+C = plan_cols(T1p, K, kernel="dense").cols
 tpl_pad = np.zeros(Tmax, np.int8)
 tpl_pad[:tlen] = template
 Npad = ((batch.n_reads + 127) // 128) * 128

@@ -51,7 +51,9 @@ bufs = fill_pallas.build_fill_buffers(
     jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
 )
 jax.block_until_ready(bufs)
-C = fill_pallas._pick_cols(T1p, K)
+from rifraf_tpu.utils.shapes import plan_cols
+
+C = plan_cols(T1p, K, kernel="fill").cols
 print(f"K={K} T1p={T1p} C={C} Npad={Npad} backend={jax.default_backend()}",
       flush=True)
 

@@ -2,7 +2,8 @@
 
 Measures (dependent-chain, warm) the fused iteration step and its stats
 variants, counts the HBM bytes each program must move and the VPU work
-per cell, and prints achieved fractions of the chip's rooflines.
+per cell (shared models: rifraf_tpu.utils.roofline), and prints achieved
+fractions of the chip's rooflines.
 
 Usage: python exp/roofline.py [TLEN] [N_READS] [BW]
 """
@@ -20,16 +21,15 @@ import jax.numpy as jnp
 from rifraf_tpu.models.errormodel import ErrorModel, Scores
 from rifraf_tpu.models.sequences import batch_reads, make_read_scores
 from rifraf_tpu.ops import align_jax, dense_pallas, fill_pallas
+from rifraf_tpu.utils import roofline
+from rifraf_tpu.utils.shapes import plan_cols
 
 TLEN = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
 N_READS = int(sys.argv[2]) if len(sys.argv) > 2 else 256
 BW = int(sys.argv[3]) if len(sys.argv) > 3 else 16
 
-# v5e public peaks (cloud.google.com/tpu/docs/v5e): 819 GB/s HBM BW,
-# 394 bf16 TFLOP/s MXU (unused here: the DP has no matmuls). The VPU
-# f32 roof is ~ (8 * 128 lanes * 4 ALUs * ~0.94 GHz) ~ 3.8 Top/s.
-HBM_GBPS = 819.0
-VPU_TOPS = 3.8
+HBM_GBPS = roofline.HBM_GBPS
+VPU_TOPS = roofline.VPU_TOPS
 
 scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
 rng = np.random.default_rng(3)
@@ -58,25 +58,14 @@ bufs = fill_pallas.build_fill_buffers(
     jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
 )
 jax.block_until_ready(bufs)
-C = dense_pallas.pick_dense_cols(T1p, K)
-n_steps = T1p // C
-CB = C + K
-print(f"K={K} T1p={T1p} C={C} Npad={Npad} backend={jax.default_backend()}")
+plan = plan_cols(T1p, K, kernel="dense")
+C = plan.cols
+C_fill = plan_cols(T1p, K, kernel="fill", want_moves=True).cols
+print(f"K={K} T1p={T1p} C={C} (vmem {plan.vmem_bytes >> 10} KiB) "
+      f"Npad={Npad} backend={jax.default_backend()}")
 
 t_dev = jnp.asarray(tpl)
 w = jnp.ones(N_READS, jnp.float32)
-
-
-def chain_time(f, x0, n=5):
-    """Dependent-chain timing: each call's template derives from the
-    previous call's output so no async overlap hides latency."""
-    out = f(x0, 0)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for i in range(n):
-        out = f(x0, jnp.int32(i) * 0 + (out[1] if isinstance(out, tuple) else out)[0].astype(jnp.int32) * 0)
-        jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n
 
 
 def run_fused(t, _dep):
@@ -94,8 +83,7 @@ def run_fused_stats(t, _dep):
 
 def run_fill_stats(t, _dep):
     return dense_pallas.fill_stats_pallas(
-        t_dev, jnp.int32(tlen), bufs, geom, K,
-        T1p, fill_pallas._pick_cols(T1p, K, want_moves=True),
+        t_dev, jnp.int32(tlen), bufs, geom, K, T1p, C_fill,
     )
 
 
@@ -115,36 +103,23 @@ def dep_chain(make, n=5):
 cells = 2 * K * T1p * Npad  # fwd + rev streams
 GB = 1e9
 
-# ---- HBM bytes per program (analytic) ----
-# fill kernel: 5 blocked tables per stream, halo'd (CB rows per C cols),
-# read once per grid step; band output written once; moves (stats
-# variants) written once as int32 then cast.
-tab_bytes = 2 * 5 * n_steps * CB * Npad * 4
-band_bytes = 2 * K * T1p * Npad * 4
-moves_bytes = K * T1p * (2 * Npad) * 4  # int32 out (fwd lanes used)
-# dense kernel: reads A (fwd half of band), halo-blocked B (written then
-# read), 5 fwd tables again; writes [T1p, 16, Npad] join maxima.
-bh_bytes = n_steps * (C + 1) * K * Npad * 4
-dense_read = K * T1p * Npad * 4 + bh_bytes + 5 * n_steps * CB * Npad * 4
-dense_out = T1p * 16 * Npad * 4
-fused_bytes = tab_bytes + band_bytes + bh_bytes * 2 + dense_read + dense_out
+# ---- HBM bytes / VPU ops per program (shared analytic models) ----
+m_fused = roofline.fused_model(T1p, K, Npad, C)
+m_stats = roofline.fused_model(T1p, K, Npad, C, want_stats=True)
+m_fill = roofline.fill_model(T1p, K, Npad, C_fill, n_streams=1,
+                             want_moves=True, moves_lanes=Npad)
+m_fstat = roofline.stats_model(T1p, K, Npad, C_fill)
 
 t_fused = dep_chain(run_fused)
 t_stats = dep_chain(run_fused_stats)
 t_fill_stats = dep_chain(run_fill_stats)
 
-# VPU work per cell in the fill: ~2 table selects, 2 adds + max (cand),
-# 2 log-K scans (add + max) ~ 2*log2(K) ops, one select ~= 8 + 2*log2K
-ops_cell = 8 + 2 * np.log2(K)
-fill_ops = cells * ops_cell
-# dense: per column per base 2 scans + joins over K rows, 9 outputs
-dense_ops = T1p * Npad * K * (8 * (4 + 2 * np.log2(K)) + 10)
-
 for label, t, bts, ops in (
-    ("fused fill+align+dense", t_fused, fused_bytes, fill_ops + dense_ops),
-    ("  + stats (moves+scan)", t_stats, fused_bytes + moves_bytes, None),
+    ("fused fill+align+dense", t_fused, m_fused["bytes"], m_fused["ops"]),
+    ("  + stats (on-core rev sweep)", t_stats, m_stats["bytes"],
+     m_stats["ops"]),
     ("adapt fill+stats (fwd only)", t_fill_stats,
-     tab_bytes / 2 + band_bytes / 2 + moves_bytes / 2, None),
+     m_fill["bytes"] + m_fstat["bytes"], m_fill["ops"] + m_fstat["ops"]),
 ):
     line = (f"{label}: {t*1e3:8.2f} ms | {bts/GB:6.2f} GB -> "
             f"{bts/GB/t:6.1f} GB/s ({100*bts/GB/t/HBM_GBPS:5.1f}% of HBM roof)")
@@ -188,12 +163,14 @@ def scan_stats(t0):
     return jax.lax.scan(body, t0, None, length=N_SCAN)[1]
 
 
-for label, fn in (("fused", scan_fused), ("fused+stats", scan_stats)):
+for label, fn, bts in (
+    ("fused", scan_fused, m_fused["bytes"]),
+    ("fused+stats", scan_stats, m_stats["bytes"]),
+):
     jax.block_until_ready(fn(t_dev))
     t0 = time.perf_counter()
     jax.block_until_ready(fn(t_dev))
     dt = (time.perf_counter() - t0) / N_SCAN
-    bts = fused_bytes + (moves_bytes if "stats" in label else 0)
     print(f"device-only {label}: {dt*1e3:7.2f} ms/iter | "
           f"{bts/GB/dt:6.1f} GB/s ({100*bts/GB/dt/HBM_GBPS:5.1f}% HBM) | "
           f"cells/s {cells/dt/1e9:.2f} G")

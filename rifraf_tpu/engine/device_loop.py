@@ -236,6 +236,7 @@ def make_stage_runner(
     stop_on_same: bool,
     do_subs: bool = True,
     gate: str = "none",
+    plan=None,
 ):
     """Build the jitted whole-stage runner. ``step_fn`` takes the
     device-resident batch state as an ARGUMENT pytree (not a closure) so
@@ -248,7 +249,12 @@ def make_stage_runner(
     gate pytree for the template it just scored (edits_seen array for
     "edits", (ins_gate, del_gate) for "seeds") — which rides the carry
     alongside the tables so candidate masking always matches the
-    template the tables describe."""
+    template the tables describe.
+
+    ``plan`` is opaque diagnostic metadata (the utils.shapes.BlockPlan
+    the step was built with, for Pallas steps) attached to the returned
+    runner as ``runner.plan`` so sweep/bench reporting can show which
+    VMEM blocking each cached stage program uses."""
 
     def cond(carry):
         return jnp.logical_not(carry["done"]) & (
@@ -420,4 +426,5 @@ def make_stage_runner(
     # axis (parallel.sweep_sharded) vmap this directly and unpack the
     # packed rows themselves
     runner.run = run
+    runner.plan = plan
     return runner

@@ -657,19 +657,32 @@ def estimate_probs(state: RifrafState, params: RifrafParams) -> EstimatedProbs:
         and len(state.reference) > 0
         and params.use_ref_for_qvs
     )
-    proposals = all_proposals(Stage.SCORE, state.consensus, False)
-    scores = state.aligner.score_proposals(proposals)
-    if uref:
-        scores = scores + state.ref_aligner.score_proposals(
-            proposals, state.consensus, state.reference
-        )
-    for p, score in zip(proposals, scores):
-        if isinstance(p, Substitution):
-            sub_scores[p.pos, p.base] = score
-        elif isinstance(p, Deletion):
-            del_scores[p.pos] = score
-        else:
-            ins_scores[p.pos, p.base] = score
+    tables = None if uref else state.aligner.dense_score_tables(tlen)
+    if tables is not None:
+        # the realign already shipped batch-total scores for EVERY
+        # single-base edit: read the whole tables at once. SCORE-stage
+        # proposals are exactly all non-identity subs + all indels
+        # (generate.all_proposals), so only the identity-substitution
+        # slots keep the no-change score
+        sub_t, ins_t, del_t = tables
+        sub_scores[:] = sub_t
+        sub_scores[np.arange(tlen), state.consensus] = state.score
+        del_scores[:] = del_t
+        ins_scores[:] = ins_t
+    else:
+        proposals = all_proposals(Stage.SCORE, state.consensus, False)
+        scores = state.aligner.score_proposals(proposals)
+        if uref:
+            scores = scores + state.ref_aligner.score_proposals(
+                proposals, state.consensus, state.reference
+            )
+        for p, score in zip(proposals, scores):
+            if isinstance(p, Substitution):
+                sub_scores[p.pos, p.base] = score
+            elif isinstance(p, Deletion):
+                del_scores[p.pos] = score
+            else:
+                ins_scores[p.pos, p.base] = score
     max_score = max(sub_scores.max(), del_scores.max(), ins_scores.max())
     sub_scores -= max_score
     del_scores -= max_score

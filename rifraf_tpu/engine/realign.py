@@ -52,6 +52,51 @@ def _pallas_interpret() -> bool:
 _BYTES_PER_CELL = 22  # A+B f32, moves int8, ~2 transient copies
 
 
+def _dense_cols(T1p: int, K: int, Npad: int = 0,
+                want_stats: bool = False) -> int:
+    """Column block for the fused/dense Pallas dispatches via the shared
+    VMEM planner (utils.shapes.plan_cols), recording the block plan and
+    modelled HBM traffic so bench/diagnostics can report roofline
+    utilization per dispatch. Interpret mode (CPU tests) pins C=8 to
+    keep the traced kernel body bounded."""
+    from ..utils import roofline
+    from ..utils.shapes import plan_cols
+
+    plan = plan_cols(T1p, K, kernel="dense")
+    C = 8 if _pallas_interpret() else plan.cols
+    if Npad:
+        model = roofline.fused_model(T1p, K, Npad, C,
+                                     want_stats=want_stats)
+        roofline.record(
+            "fused_step", T1p=T1p, K=K, Npad=Npad, C=C,
+            vmem_bytes=plan.vmem_bytes, model_bytes=model["bytes"],
+            model_ops=model["ops"], want_stats=want_stats,
+        )
+    return C
+
+
+def _fill_cols(T1p: int, K: int, Npad: int = 0) -> int:
+    """Column block for the forward-only fill+stats dispatch (adapt
+    rounds): the fill plan must also hold the int32 move block in VMEM
+    (want_moves=True)."""
+    from ..utils import roofline
+    from ..utils.shapes import plan_cols
+
+    plan = plan_cols(T1p, K, kernel="fill", want_moves=True)
+    C = 8 if _pallas_interpret() else plan.cols
+    if Npad:
+        f = roofline.fill_model(T1p, K, Npad, C, n_streams=1,
+                                want_moves=True, moves_lanes=Npad)
+        s = roofline.stats_model(T1p, K, Npad, C)
+        roofline.record(
+            "fill_stats", T1p=T1p, K=K, Npad=Npad, C=C,
+            vmem_bytes=plan.vmem_bytes,
+            model_bytes=f["bytes"] + s["bytes"],
+            model_ops=f["ops"] + s["ops"],
+        )
+    return C
+
+
 def _default_hbm_budget() -> float:
     """HBM working-set budget for one fused step: band buffers (A, B,
     moves) plus XLA's transient copies scale with reads x K x T1; beyond
@@ -359,15 +404,14 @@ class BatchAligner:
         import jax.numpy as jnp
 
         from ..ops import align_jax
-        from ..ops.dense_pallas import fused_step_pallas, pick_dense_cols
+        from ..ops.dense_pallas import fused_step_pallas
 
         T = len(t)
         T1 = T + 1
         T1p = _bucket(T1, 64)
         K = self._pallas_K(tlen)
-        # interpret mode (CPU tests): a small column unroll keeps the
-        # traced kernel body — and its CPU compile time — bounded
-        C = 8 if _pallas_interpret() else pick_dense_cols(T1p, K)
+        C = _dense_cols(T1p, K, _bucket(self.batch.n_reads, 128),
+                        want_stats=want_stats)
         bufs = self._ensure_fill_bufs()
         batch = self._current_batch()
         self.n_forward_fills += 1
@@ -411,17 +455,14 @@ class BatchAligner:
         import jax.numpy as jnp
 
         from ..ops import align_jax
-        from ..ops.dense_pallas import (
-            fused_tables_pallas_panels,
-            pick_dense_cols,
-        )
+        from ..ops.dense_pallas import fused_tables_pallas_panels
 
         T = len(t)
         T1 = T + 1
         T1p = _bucket(T1, 64)
         K = self._pallas_K(tlen)
-        C = 8 if _pallas_interpret() else pick_dense_cols(T1p, K)
         Npad = _bucket(self.batch.n_reads, 128)
+        C = _dense_cols(T1p, K, Npad, want_stats=want_stats)
         # panel size: per-panel temporaries (~2.2 band-panels) stay a
         # small fraction of the budget; multiple of C
         per_col = 13 * K * Npad * 4
@@ -555,9 +596,7 @@ class BatchAligner:
         lengths_dev = jnp.asarray(self._lengths_host)
 
         if use_pallas:
-            from ..ops.dense_pallas import pick_dense_cols
-
-            C = pick_dense_cols(T1p, K)
+            C = _dense_cols(T1p, K)
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_stage_runner(
                 K, T1p, C, do_indels, min_dist,
@@ -653,9 +692,7 @@ class BatchAligner:
             rt9s = tuple(eng._tables(ref.bandwidth, True)[:9])
 
         if use_pallas:
-            from ..ops.dense_pallas import pick_dense_cols
-
-            C = 8 if _pallas_interpret() else pick_dense_cols(T1p, K)
+            C = _dense_cols(T1p, K)
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_frame_runner(
                 K, T1p, C, True, do_subs, min_dist, history_cap, Tmax,
@@ -872,11 +909,10 @@ class BatchAligner:
         import jax.numpy as jnp
 
         from ..ops.dense_pallas import fill_stats_pallas
-        from ..ops.fill_pallas import _pick_cols
 
         T1p = _bucket(int(t_dev.shape[0]) + 1, 64)
         K = self._pallas_K(tlen)
-        C = 8 if _pallas_interpret() else _pick_cols(T1p, K, want_moves=True)
+        C = _fill_cols(T1p, K, _bucket(self.batch.n_reads, 128))
         bufs = self._ensure_fill_bufs()
         batch = self._current_batch()
         self.n_forward_fills += 1
@@ -982,6 +1018,20 @@ class BatchAligner:
             else:
                 outs.append(np.asarray(per_read).sum(axis=0))
         return np.concatenate(outs) if len(outs) > 1 else outs[0]
+
+    def dense_score_tables(self, tlen: int):
+        """The cached dense all-edit score tables for the CURRENT
+        consensus, truncated to the true length: (sub [tlen, 4], ins
+        [tlen + 1, 4], del [tlen]), or None when the last realign did
+        not ship tables (sparse fallback engines). driver.estimate_probs
+        reads the whole tables in one shot instead of materializing and
+        scoring ~5*tlen single-edit Proposal objects."""
+        if self._tables_host is None:
+            return None
+        sub_t, ins_t, del_t = self._tables_host
+        if sub_t.shape[0] < tlen + 1:
+            return None
+        return sub_t[:tlen], ins_t[: tlen + 1], del_t[:tlen]
 
     @staticmethod
     def _read_tables(tables, proposals: Sequence[Proposal]) -> np.ndarray:
@@ -1164,9 +1214,12 @@ def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
             ),)
         return base
 
+    from ..utils.shapes import plan_cols
+
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
         do_subs=do_subs, gate="seeds" if seed_gate else "none",
+        plan=plan_cols(T1p, K, kernel="dense"),
     )
 
 
@@ -1234,9 +1287,12 @@ def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
             return base + (out["edits"],)
         return base
 
+    from ..utils.shapes import plan_cols
+
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
         gate="edits" if use_edits else "none",
+        plan=plan_cols(T1p, K, kernel="dense"),
     )
 
 

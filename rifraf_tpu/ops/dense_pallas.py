@@ -54,6 +54,7 @@ from jax.experimental.pallas import tpu as pltpu
 # accept either so the kernel builds on both sides of the rename.
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
+from . import stats_pallas
 from .align_jax import BandGeometry
 from .fill_pallas import (
     LANES,
@@ -427,17 +428,27 @@ def fused_tables_pallas(
         "sub": sub_t, "ins": ins_t, "del": del_t,
     }
     if need_moves:
-        moves = _moves_band(moves_flat, K, T1p, Npad)
         if want_stats:
             T1 = template.shape[0] + 1
-            nerr, edits = stats_from_moves(
-                moves[:, :, :T1], bufs.seq_T.T, template, geom,
-                bufs.lengths, K, off_override=off_override,
-            )
+            if stats_pallas.use_pallas_stats():
+                # on-core reverse sweep straight over the fill kernel's
+                # raw int32 move band (no int8 round trip, no XLA scan)
+                nerr, edits = stats_pallas.traceback_stats_pallas(
+                    p, moves_flat, K, T1p, C, Npad, T1,
+                    interpret=interpret,
+                )
+            else:
+                moves = _moves_band(moves_flat, K, T1p, Npad)
+                nerr, edits = stats_from_moves(
+                    moves[:, :, :T1], bufs.seq_T.T, template, geom,
+                    bufs.lengths, K, off_override=off_override,
+                )
             out["n_errors"] = nerr
             out["edits"] = edits
         if want_moves:
-            out["moves"] = moves
+            out["moves"] = _moves_band(
+                moves_flat, K, T1p, Npad
+            ).astype(jnp.int8)
     return out
 
 
@@ -524,32 +535,22 @@ def fill_stats_pallas(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
         K=K, T1p=T1p, NBLK=NB, C=C, want_moves=True, interpret=interpret,
     )
-    moves = _moves_band(moves_flat, K, T1p, Npad)
     T1 = template.shape[0] + 1
-    nerr, _ = stats_from_moves(
-        moves[:, :, :T1], bufs.seq_T.T, template, geom, bufs.lengths, K,
-        off_override=off_override,
-    )
+    if stats_pallas.use_pallas_stats():
+        # adaptation only needs n_errors: skip the indicator tiles
+        nerr, _ = stats_pallas.traceback_stats_pallas(
+            p, moves_flat, K, T1p, C, Npad, T1, want_edits=False,
+            interpret=interpret,
+        )
+    else:
+        moves = _moves_band(moves_flat, K, T1p, Npad)
+        nerr, _ = stats_from_moves(
+            moves[:, :, :T1], bufs.seq_T.T, template, geom, bufs.lengths,
+            K, off_override=off_override,
+        )
     return jnp.concatenate(
         [scores2[0, :Npad], nerr.astype(jnp.float32)]
     )
-
-
-def pick_dense_cols(T1p: int, K: int, vmem_budget: int = 9 << 20) -> int:
-    """Columns per dense grid step: largest power-of-two divisor of T1p
-    whose double-buffered working set (A block C*K + B halo (C+1)*K +
-    5 tables (C+K) + out C*ROWS, all [.., 128] f32) fits the budget.
-    Capped at T1p // 2 so the backward halo slice (C + 1 columns) always
-    fits inside the band."""
-    best = 1
-    c = 1
-    while c <= min(T1p // 2, 256):
-        if T1p % c == 0:
-            rows = c * K + (c + 1) * K + 5 * (c + K) + c * ROWS
-            if 2 * 128 * 4 * rows <= vmem_budget:
-                best = c
-        c *= 2
-    return best
 
 
 # --- panel-blocked long-template path --------------------------------------
@@ -733,15 +734,26 @@ def fused_tables_pallas_panels(
         "del": jnp.concatenate(dels_t)[:T1p],
     }
     if need_moves:
-        moves = _moves_band(moves_flat, K, T1p_pad, Npad)
         if want_stats:
             T1 = template.shape[0] + 1
-            nerr, edits = stats_from_moves(
-                moves[:, :, :T1], bufs.seq_T.T, template, geom,
-                bufs.lengths, K,
-            )
+            if (stats_pallas.use_pallas_stats()
+                    and stats_pallas.int8_moves_ok(K, C)):
+                # reverse panel sweep over the accumulated int8 move
+                # band, carry-chained right-to-left; per-panel tiles are
+                # lane-reduced immediately so the transient stays
+                # O(panel), like the dense slices above
+                nerr, edits = stats_pallas.traceback_stats_pallas_panels(
+                    pp, moves_flat, K, T1p_pad, P, C, Npad, T1,
+                    interpret=interpret,
+                )
+            else:
+                moves = _moves_band(moves_flat, K, T1p_pad, Npad)
+                nerr, edits = stats_from_moves(
+                    moves[:, :, :T1], bufs.seq_T.T, template, geom,
+                    bufs.lengths, K,
+                )
             out["n_errors"] = nerr
             out["edits"] = edits
         if want_moves:
-            out["moves"] = moves
+            out["moves"] = _moves_band(moves_flat, K, T1p_pad, Npad)
     return out

@@ -84,6 +84,7 @@ from .align_np import (
     TRACE_MATCH,
     TRACE_NONE,
 )
+from ..utils.shapes import plan_cols
 
 # finite sentinel: avoids -inf arithmetic on the VPU (inf - inf = nan in
 # the chain's cand - G); half of float32 min keeps all sums finite
@@ -292,24 +293,6 @@ def _fill_kernel(
             carry_out[:] = prev
 
 
-def _pick_cols(T1p: int, K: int, vmem_budget: int = 9 << 20,
-               want_moves: bool = False) -> int:
-    """Columns per grid step: the largest divisor of T1p whose working
-    set (double-buffered output block [C*K, 128] f32 — twice that with a
-    move-band output — + 5 double-buffered table blocks [C+K, 128]) fits
-    the VMEM budget. T1p is a multiple of 64 for bucketed templates."""
-    out_blocks = 2 if want_moves else 1
-    best = 1
-    c = 1
-    while c <= min(T1p, 512):
-        if T1p % c == 0:
-            need = 2 * 128 * 4 * (out_blocks * c * K + 5 * (c + K))
-            if need <= vmem_budget:
-                best = c
-        c *= 2
-    return best
-
-
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "NBLK", "C", "want_moves", "interpret"),
@@ -446,7 +429,10 @@ def _fill_call(
     outs = list(outs)
     out_band = outs.pop(0)
     scores = outs.pop(0)
-    moves = outs.pop(0).astype(jnp.int8) if want_moves else None
+    # moves stay RAW int32: the Pallas stats kernel consumes them in
+    # this exact layout/dtype (no int8 round trip); exporting callers
+    # (fill_uniform) cast at the boundary instead
+    moves = outs.pop(0) if want_moves else None
     if has_carry:
         carry_out = outs.pop(0)
         return out_band, scores, moves, carry_out
@@ -742,7 +728,7 @@ def fill_uniform(
     Npad = bufs.seq_T.shape[1]
     NB = Npad // LANES
     if C <= 0:
-        C = _pick_cols(T1p, K, want_moves=want_moves)
+        C = plan_cols(T1p, K, kernel="fill", want_moves=want_moves).cols
     p = prepare_fill(template, tlen, bufs, geom, K, T1p, C, with_backward)
     NBLK = 2 * NB if with_backward else NB
     band_flat, scores, moves_flat = _fill_call(
@@ -758,6 +744,7 @@ def fill_uniform(
         moves = (
             moves_flat.reshape(T1p, K, NBLK * LANES)
             .transpose(2, 1, 0)[:Npad]
+            .astype(jnp.int8)
         )
     if with_backward:
         Brev = band[Npad:]

@@ -1,0 +1,483 @@
+"""On-core Pallas reverse-sweep traceback statistics.
+
+Third Pallas kernel of the engine: consumes the fill kernel's in-kernel
+move codes DIRECTLY in the uniform-frame band layout (flat
+[T1p * K, lanes], reads on lanes — the exact buffer `_fill_call`
+emits) and computes, in one sequential sweep over column blocks from
+the last template column down to column 0:
+
+- per-lane alignment error counts of the optimal path
+  (count_errors, align.jl:240-250) and the path-completeness flag;
+- per-column single-base-edit indicators (moves_to_proposals,
+  model.jl:458-480) emitted as small [16, 128] tiles per column — the
+  same output shape as the dense kernel's join maxima, reduced over
+  lanes in XLA.
+
+This replaces the XLA moves scan (align_jax._traceback_stats_one via
+dense_pallas.stats_from_moves) on the Pallas path: that scan re-reads
+the move band through an unrolled lax.scan at ~3x the fill kernel's
+wall clock (round-5 roofline: 30 ms stats vs 10 ms fill at
+1 kb x 2048) because each unrolled column pays XLA op overhead on [K]
+vectors. Here the sweep is straight-line code on [K, 128] tiles with
+the same grid/blocking as the fill — the move band streams through
+VMEM once, so the stats step is bounded by its bytes, not its columns.
+
+Recurrence (one column j, all lanes):
+
+  seed[d]   = P[d] | (j == tlen & d == dend)        # end-cell seed
+  on        = insert-chain closure of seed           # see below
+  is_m/i/d  = on & (move == MATCH / INSERT / DELETE)
+  nerr     += sum_d(mismatch | is_i | is_d)
+  P'[d]     = is_m[d] | is_d[d-1]                    # col j-1 seeds
+
+The insert-chain closure (on-path membership propagates DOWNWARD in d
+through runs of INSERT moves: on[d] = seed[d] | (on[d+1] & ins[d+1]))
+uses the same max-plus closed form as the XLA oracle
+(align_jax._resolve_insert_chain) but WITHOUT the axis flips: with
+g[d] = 0 if ins[d+1] else -1e6 and cand[d] = 0 if seed[d] else -1e12,
+
+  F = Gs + suffix_cummax(cand - Gs),   Gs = suffix_cumsum(g)
+
+and on = F > -1e5. Suffix scans run along sublanes via log-step rolls
+(`_cumop_rev`, the mirror of fill_pallas._cumop). Bit-identity with the
+oracle holds because every partial sum is an exact small multiple of
+1e6 in f32 (path lengths <= K <= 1024), so the scan association order
+cannot perturb any value, and the downstream outputs are pure booleans
+/ int32 counts of those booleans (tests/test_stats_pallas.py pins the
+equality across geometries in interpret mode).
+
+The kernel accepts the move band as int32 (the fill kernel's raw
+output — the fused path feeds it straight through, no int8 round trip)
+or int8 (the panel path's accumulated band; widened on load). Panel
+launches chain (P, nerr, reached) through a [K + 8, lanes] carry, run
+in REVERSE panel order.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# pallas renamed TPUCompilerParams -> CompilerParams across jax releases;
+# accept either so the kernel builds on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+from .align_np import TRACE_DELETE, TRACE_INSERT, TRACE_MATCH
+from .fill_pallas import LANES
+
+ROWS = 16  # per-column indicator tile rows (9 used; dense_pallas.ROWS)
+CARRY_ROWS = 8  # accumulator rows chained between panels (2 used)
+
+
+def use_pallas_stats() -> bool:
+    """Env opt-out: RIFRAF_TPU_STATS_IMPL=xla routes the Pallas paths
+    back through the XLA moves scan (stats_from_moves). Read at trace
+    time by the jitted wrappers."""
+    return os.environ.get("RIFRAF_TPU_STATS_IMPL", "pallas") != "xla"
+
+
+def _cumop_rev(x, op, K: int):
+    """Inclusive SUFFIX scan along sublanes (axis 0) via log-step
+    doubling — the mirror of fill_pallas._cumop: after the pass,
+    x[d] = op(x[d], x[d+1], ..., x[K-1])."""
+    s = 1
+    while s < K:
+        # roll(x, K - s)[d] = x[(d + s) mod K]
+        shifted = pltpu.roll(x, K - s, axis=0)
+        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+        x = jnp.where(idx < K - s, op(x, shifted), x)
+        s *= 2
+    return x
+
+
+def _stats_kernel(
+    # SMEM inputs
+    tlen_ref,  # [1, 1] true template length
+    off_ref,  # [1, 1] uniform frame offset OFF
+    col0_ref,  # [1, 1] global column of this launch's first column
+    t_ref,  # [1, n_cols] template codes (LOCAL columns)
+    # per-lane metadata, [1, 1, 128] block
+    dend_ref,  # traceback end row dend = slen - tlen + OFF
+    # band-layout blocks
+    mv_ref,  # [C * K, 128] move codes, block jb_rev (int32 or int8)
+    sq_ref,  # [1, CB, 128] blocked read-base table (fill layout)
+    *refs,
+    K: int,
+    C: int,
+    want_tiles: bool = True,
+    has_carry: bool = False,
+):
+    refs = list(refs)
+    carry_in = refs.pop(0) if has_carry else None
+    tiles_ref = refs.pop(0)
+    acc_ref = refs.pop(0)
+    carry_out = refs.pop(0) if has_carry else None
+    P_scr, acc_scr = refs
+
+    jb = pl.program_id(1)
+    n_steps = pl.num_programs(1)
+    tlen = tlen_ref[0, 0]
+    OFF = off_ref[0, 0]
+    col0 = col0_ref[0, 0]
+    # the grid's sequential axis runs FORWARD while the index maps feed
+    # blocks in reverse; block jb holds columns of block jb_rev
+    jb_rev = n_steps - 1 - jb
+
+    d = jax.lax.broadcasted_iota(jnp.int32, (K, LANES), 0)
+    dend = dend_ref[0, 0, :]
+    zero_i = jnp.zeros((1, LANES), jnp.int32)
+
+    @pl.when(jb == 0)
+    def _():
+        if has_carry:
+            P_scr[:] = carry_in[0:K, :]
+            acc_scr[:] = carry_in[K : K + CARRY_ROWS, :]
+        else:
+            P_scr[:] = jnp.zeros((K, LANES), jnp.int32)
+            acc_scr[:] = jnp.zeros((CARRY_ROWS, LANES), jnp.int32)
+
+    P = P_scr[:] > 0
+    nerr = acc_scr[0:1, :]
+    reached = acc_scr[1:2, :]
+
+    # columns DESCEND within the block (the sweep chains P toward j-1)
+    for c in range(C - 1, -1, -1):
+        j = col0 + jb_rev * C + c
+        mv = mv_ref[c * K : (c + 1) * K, :].astype(jnp.int32)
+        sb = sq_ref[0, c : c + K, :]  # = seq[i - 1], i = d + j - OFF
+        tb = t_ref[0, jb_rev * C + c]
+
+        seed = P | ((j == tlen) & (d == dend[None, :]))
+        ichain = mv == TRACE_INSERT
+
+        # on-path closure: on[d] = seed[d] | (on[d+1] & ichain[d+1]),
+        # max-plus closed form on the un-flipped axis (module docstring)
+        ich_up = pltpu.roll(ichain.astype(jnp.float32), K - 1, axis=0)
+        ich_up = jnp.where(d == K - 1, 0.0, ich_up)
+        g = jnp.where(ich_up > 0, 0.0, -1e6)
+        cand = jnp.where(seed, 0.0, -1e12)
+        Gs = _cumop_rev(g, lambda a, b: a + b, K)
+        F = Gs + _cumop_rev(cand - Gs, jnp.maximum, K)
+        on = F > -1e5
+
+        is_m = on & (mv == TRACE_MATCH)
+        is_i = on & ichain
+        is_d = on & (mv == TRACE_DELETE)
+        mism = is_m & (sb != tb)
+        err = mism | is_i | is_d
+        # dtype pinned: under x64, jnp.sum would promote int32 to int64
+        # and poison the int32 accumulator scratch
+        nerr = nerr + jnp.sum(err.astype(jnp.int32), axis=0,
+                              keepdims=True, dtype=jnp.int32)
+        # a complete path reaches cell (0, 0) = data row OFF of column 0
+        r0 = jnp.sum(
+            (on & (d == OFF)).astype(jnp.int32), axis=0, keepdims=True,
+            dtype=jnp.int32,
+        )
+        reached = reached | jnp.where(j == 0, r0, zero_i)
+
+        if want_tiles:
+            def any_row(m):
+                return jnp.max(m.astype(jnp.float32), axis=0, keepdims=True)
+
+            rows = (
+                [any_row(mism & (sb == b)) for b in range(4)]
+                + [any_row(is_i & (sb == b)) for b in range(4)]
+                + [any_row(is_d),
+                   jnp.zeros((ROWS - 9, LANES), jnp.float32)]
+            )
+            tiles_ref[c * ROWS : (c + 1) * ROWS, :] = jnp.concatenate(
+                rows, axis=0
+            )
+
+        # seeds for column j - 1: match pred at the same data row,
+        # delete pred one data row down
+        is_d_dn = pltpu.roll(is_d.astype(jnp.float32), 1, axis=0)
+        is_d_dn = jnp.where(d == 0, 0.0, is_d_dn)
+        P = is_m | (is_d_dn > 0)
+
+    P_scr[:] = P.astype(jnp.int32)
+    acc_new = jnp.concatenate(
+        [nerr, reached, jnp.zeros((CARRY_ROWS - 2, LANES), jnp.int32)],
+        axis=0,
+    )
+    acc_scr[:] = acc_new
+
+    @pl.when(jb == n_steps - 1)
+    def _():
+        acc_ref[:] = acc_new
+        if has_carry:
+            carry_out[0:K, :] = P.astype(jnp.int32)
+            carry_out[K : K + CARRY_ROWS, :] = acc_new
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("K", "T1p", "NB", "C", "want_tiles", "interpret"),
+)
+def _stats_call(
+    tlen_s,  # [1, 1] int32
+    off_s,  # [1, 1] int32
+    t_cols,  # [1, T1p] int32 template columns (to_cols layout)
+    dend,  # [1, nlanes] int32 (>= NB * 128 lanes; extras ignored)
+    mv_flat,  # [T1p * K, nlanes] int32 or int8 move band (fill layout)
+    sq,  # [n_steps, CB, nlanes] blocked read-base table (fill layout)
+    K: int,
+    T1p: int,
+    NB: int,
+    C: int,
+    want_tiles: bool = True,
+    interpret: bool = False,
+    col0=None,  # [1, 1] int32 global first column (panel launches)
+    carry_in=None,  # [K + 8, NB*128] int32 previous panel's state
+):
+    """One reverse stats sweep over ``T1p`` columns and ``NB`` forward
+    lane blocks (``mv_flat``/``sq``/``dend`` may carry extra reversed
+    lanes — the lane-block index never touches them). Returns
+    (tiles [T1p * 16, NB*128] f32 — or a [8, NB*128] dummy when
+    ``want_tiles`` is False —, acc [8, NB*128] int32 with rows
+    0 = n_errors and 1 = reached-origin, carry_out when chaining)."""
+    n_steps = T1p // C
+    CB = sq.shape[1]
+    has_carry = carry_in is not None
+    if col0 is None:
+        col0 = jnp.zeros((1, 1), jnp.int32)
+
+    grid = (NB, n_steps)
+
+    def smem_spec():
+        return pl.BlockSpec(
+            (1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM
+        )
+
+    in_specs = [
+        smem_spec(),  # tlen
+        smem_spec(),  # off
+        smem_spec(),  # col0
+        pl.BlockSpec(
+            (1, t_cols.shape[1]), lambda nb, jb: (0, 0),
+            memory_space=pltpu.SMEM,
+        ),
+        pl.BlockSpec(
+            (1, 1, LANES), lambda nb, jb: (0, 0, nb),
+            memory_space=pltpu.VMEM,
+        ),  # dend
+        # REVERSE feed: sequential step jb reads column block
+        # n_steps - 1 - jb
+        pl.BlockSpec(
+            (C * K, LANES),
+            lambda nb, jb, n=n_steps: (n - 1 - jb, nb),
+            memory_space=pltpu.VMEM,
+        ),  # moves
+        pl.BlockSpec(
+            (1, CB, LANES),
+            lambda nb, jb, n=n_steps: (n - 1 - jb, 0, nb),
+            memory_space=pltpu.VMEM,
+        ),  # sq
+    ]
+    args = [
+        tlen_s, off_s, jnp.asarray(col0, jnp.int32).reshape(1, 1),
+        t_cols, dend[None], mv_flat, sq,
+    ]
+    if has_carry:
+        in_specs.append(
+            pl.BlockSpec(
+                (K + CARRY_ROWS, LANES), lambda nb, jb: (0, nb),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        args.append(carry_in)
+
+    if want_tiles:
+        tiles_spec = pl.BlockSpec(
+            (C * ROWS, LANES),
+            lambda nb, jb, n=n_steps: (n - 1 - jb, nb),
+            memory_space=pltpu.VMEM,
+        )
+        tiles_shape = jax.ShapeDtypeStruct(
+            (n_steps * C * ROWS, NB * LANES), jnp.float32
+        )
+    else:
+        # dummy block every step revisits; never read back
+        tiles_spec = pl.BlockSpec(
+            (8, LANES), lambda nb, jb: (0, nb), memory_space=pltpu.VMEM
+        )
+        tiles_shape = jax.ShapeDtypeStruct((8, NB * LANES), jnp.float32)
+
+    out_specs = [
+        tiles_spec,
+        pl.BlockSpec(
+            (CARRY_ROWS, LANES), lambda nb, jb: (0, nb),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+    out_shape = [
+        tiles_shape,
+        jax.ShapeDtypeStruct((CARRY_ROWS, NB * LANES), jnp.int32),
+    ]
+    if has_carry:
+        out_specs.append(
+            pl.BlockSpec(
+                (K + CARRY_ROWS, LANES), lambda nb, jb: (0, nb),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((K + CARRY_ROWS, NB * LANES), jnp.int32)
+        )
+
+    outs = pl.pallas_call(
+        functools.partial(
+            _stats_kernel, K=K, C=C, want_tiles=want_tiles,
+            has_carry=has_carry,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((K, LANES), jnp.int32),
+            pltpu.VMEM((CARRY_ROWS, LANES), jnp.int32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+    outs = list(outs)
+    tiles = outs.pop(0)
+    acc = outs.pop(0)
+    if has_carry:
+        return tiles, acc, outs.pop(0)
+    return tiles, acc
+
+
+def _edits_from_union(um_bool):
+    """[T1, 16] lane-union indicators -> the [T1, 9] edits table in
+    stats_from_moves's row convention: column jc emits substitutions /
+    deletions at template position jc - 1, insertions at jc."""
+    sub_any = um_bool[:, 0:4]
+    ins_any = um_bool[:, 4:8]
+    del_any = um_bool[:, 8]
+    zrow = jnp.zeros((1, 4), bool)
+    sub_t = jnp.concatenate([sub_any[1:], zrow])
+    del_t = jnp.concatenate([del_any[1:], jnp.zeros((1,), bool)])
+    return jnp.concatenate(
+        [sub_t, ins_any, del_t[:, None]], axis=1
+    ).astype(jnp.int8)
+
+
+def _finish_nerr(acc, Npad: int):
+    """Per-lane error counts; incomplete paths (never reached the
+    origin cell) report -1, matching count_errors on the XLA path."""
+    return jnp.where(acc[1, :Npad] > 0, acc[0, :Npad], -1).astype(
+        jnp.int32
+    )
+
+
+def traceback_stats_pallas(
+    prep: dict,  # prepare_fill output (tlen_s/off_s/t_cols/meta/fwd_tabs)
+    mv_flat,  # [T1p * K, nlanes] int32 move band straight from _fill_call
+    K: int,
+    T1p: int,
+    C: int,
+    Npad: int,
+    T1: int,  # template length + 1 (sizes the edits table)
+    want_edits: bool = True,
+    interpret: bool = False,
+):
+    """Stats for a single-launch fill: reuses the fill's prepared
+    inputs verbatim (same C, same blocked read-base table, dend from the
+    same meta — so the sweep sees exactly the frame the moves were
+    recorded in). Returns (n_errors [Npad] int32, edits [T1, 9] int8 or
+    None)."""
+    NB = Npad // LANES
+    tiles, acc = _stats_call(
+        prep["tlen_s"], prep["off_s"], prep["t_cols"][:1], prep["meta"][3],
+        mv_flat, prep["fwd_tabs"][4],
+        K=K, T1p=T1p, NB=NB, C=C, want_tiles=want_edits,
+        interpret=interpret,
+    )
+    nerr = _finish_nerr(acc, Npad)
+    if not want_edits:
+        return nerr, None
+    um = jnp.max(tiles.reshape(T1p, ROWS, NB * LANES), axis=2)[:T1]
+    return nerr, _edits_from_union(um > 0.0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("K", "P", "C", "NB", "interpret")
+)
+def _panel_stats(
+    tlen_s, off_s, dend, placed_sq, tpl_cols, mv_buf, col0, carry,
+    K: int, P: int, C: int, NB: int, interpret: bool = False,
+):
+    """One panel's reverse stats launch: slice the move buffer and the
+    placed read-base buffer at col0 (the fill's panel windows), block
+    the table, run the sweep with the chained carry. Returns
+    (um [P, 16] lane-union indicators, acc, carry')."""
+    from .fill_pallas import _block_tables
+
+    CB = C + K
+    n_steps = P // C
+    c0 = jnp.asarray(col0, jnp.int32)
+    mv_panel = jax.lax.dynamic_slice_in_dim(mv_buf, c0 * K, P * K, axis=0)
+    sq_win = jax.lax.dynamic_slice_in_dim(placed_sq, c0, P + K, axis=0)
+    sq = _block_tables(sq_win, n_steps, C, CB)
+    t_cols = jax.lax.dynamic_slice_in_dim(tpl_cols, c0, P)[None]
+    tiles, acc, carry2 = _stats_call(
+        tlen_s, off_s, t_cols, dend, mv_panel, sq,
+        K=K, T1p=P, NB=NB, C=C, want_tiles=True, interpret=interpret,
+        col0=jnp.reshape(c0, (1, 1)), carry_in=carry,
+    )
+    # reduce over lanes per panel: keeps the transient per-column tile
+    # store O(panel), same scaling as the dense kernel's panel slices
+    um = jnp.max(tiles.reshape(P, ROWS, NB * LANES), axis=2)
+    return um, acc, carry2
+
+
+def traceback_stats_pallas_panels(
+    prep: dict,  # prepare_fill_panels output
+    mv_buf,  # [T1p_pad * K, Npad] int8 accumulated move band
+    K: int,
+    T1p_pad: int,
+    P: int,
+    C: int,
+    Npad: int,
+    T1: int,
+    interpret: bool = False,
+):
+    """Stats for the panel-blocked path: panels sweep RIGHT-TO-LEFT
+    (the traceback direction), chaining (P, n_errors, reached) through
+    the [K + 8, Npad] carry; each panel's indicator tiles are reduced
+    over lanes before the next panel runs. Returns
+    (n_errors [Npad] int32, edits [T1, 9] int8)."""
+    NB = Npad // LANES
+    n_panels = T1p_pad // P
+    carry = jnp.zeros((K + CARRY_ROWS, Npad), jnp.int32)
+    ums = [None] * n_panels
+    acc = None
+    for p in range(n_panels - 1, -1, -1):
+        um, acc, carry = _panel_stats(
+            prep["tlen_s"], prep["off_s"], prep["meta"][3],
+            prep["fwd_placed"][4], prep["tpl_cols"], mv_buf,
+            jnp.int32(p * P), carry,
+            K=K, P=P, C=C, NB=NB, interpret=interpret,
+        )
+        ums[p] = um
+    nerr = _finish_nerr(acc, Npad)
+    um_all = jnp.concatenate(ums, axis=0)[:T1]
+    return nerr, _edits_from_union(um_all > 0.0)
+
+
+def int8_moves_ok(K: int, C: int) -> bool:
+    """int8 move-band blocks need (C * K) % 32 == 0 (the int8 sublane
+    tile is 32 rows). The panel path checks this before routing its
+    int8 buffer through the kernel; failures fall back to the XLA
+    scan."""
+    return (C * K) % 32 == 0

@@ -88,6 +88,12 @@ class BucketStats(NamedTuple):
     waste: float  # 1 - useful/padded
     seconds: float  # main-thread dispatch+fetch time (approximate
     #   under pipelining: packing overlaps other buckets' device work)
+    # read-lane tile fill if this bucket's reads were packed into
+    # 128-lane tiles longest-first (utils.shapes.pack_lanes) vs padding
+    # every lane to the bucket's Lpad — how much of the padded footprint
+    # a lane-packed Pallas engine would actually use
+    lane_occupancy: float = 1.0
+    uniform_lane_occupancy: float = 1.0
 
 
 class SweepStats(NamedTuple):
@@ -583,12 +589,19 @@ def sweep_clusters_sharded(
     if not return_stats:
         return list(out)
 
+    from ..utils.shapes import pack_lanes
+
     useful_total = sum(i.useful for i in infos)
     buckets = []
     for bi, plan in enumerate(plans):
         n_in = sum(len(ch) for ch in plan.chunks)
         padded = len(plan.chunks) * plan.gp * plan.key[0] * plan.key[1]
         useful = sum(infos[ci].useful for ch in plan.chunks for ci in ch)
+        lane_lens = [
+            len(r) for ch in plan.chunks for ci in ch
+            for r in clusters[ci]
+        ]
+        pk = pack_lanes(lane_lens)
         buckets.append(BucketStats(
             key=plan.key, n_clusters=n_in, n_chunks=len(plan.chunks),
             gp=plan.gp,
@@ -596,6 +609,8 @@ def sweep_clusters_sharded(
             useful_cells=useful, padded_cells=padded,
             waste=1.0 - useful / padded,
             seconds=bucket_seconds[bi],
+            lane_occupancy=pk.occupancy,
+            uniform_lane_occupancy=pk.uniform_occupancy,
         ))
     padded_total = plan_cells(plans)
     uniform_plans = plan_sweep(

@@ -1,0 +1,165 @@
+"""Bytes-moved / FLOP models and a per-call roofline registry.
+
+The Pallas engines are bandwidth-bound: every kernel streams its band,
+tables, and (for the stats sweep) the in-kernel move codes through HBM
+once, so seconds alone say nothing about how close a run sits to the
+hardware. This module is the single definition of the per-kernel
+byte/op models (hoisted from exp/roofline.py so bench.py, the exp
+scripts, and the realign engine all report the SAME accounting), plus a
+tiny bounded registry that non-jit wrappers use to record the block
+plan and modelled traffic of each dispatch.
+
+Peaks default to TPU v5e public numbers
+(cloud.google.com/tpu/docs/v5e): 819 GB/s HBM; the VPU f32 roof is
+~ 8 sublanes * 128 lanes * 4 ALUs * ~0.94 GHz ~ 3.8 Top/s (the MXU is
+unused: the DP has no matmuls). Override the HBM roof for other chips
+with RIFRAF_TPU_HBM_GBPS.
+
+All models count PADDED shapes (T1p columns, Npad lanes, K band rows)
+— that is what the chip actually moves; the lane-packing occupancy from
+utils.shapes.pack_lanes says how much of it was useful.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Dict, List, Optional
+
+HBM_GBPS = float(os.environ.get("RIFRAF_TPU_HBM_GBPS", "819.0"))
+VPU_TOPS = float(os.environ.get("RIFRAF_TPU_VPU_TOPS", "3.8"))
+
+_F32 = 4
+
+
+def fill_model(
+    T1p: int,
+    K: int,
+    Npad: int,
+    C: int,
+    n_streams: int = 2,
+    want_moves: bool = False,
+    moves_lanes: Optional[int] = None,
+) -> Dict[str, float]:
+    """HBM bytes + VPU ops for one fill dispatch: 5 blocked tables per
+    stream read once per grid step (halo'd: C+K rows per C columns),
+    the band written once, and — with ``want_moves`` — the int32 move
+    band written once across ``moves_lanes`` lanes (the fused layout
+    launches fwd+rev lanes but only fills the forward half)."""
+    n_steps = T1p // C
+    CB = C + K
+    tab = n_streams * 5 * n_steps * CB * Npad * _F32
+    band = n_streams * K * T1p * Npad * _F32
+    moves = 0
+    if want_moves:
+        moves = K * T1p * (moves_lanes if moves_lanes else Npad) * _F32
+    cells = n_streams * K * T1p * Npad
+    # per cell: ~2 table selects, 2 adds + max (cand), two log-K scans
+    # (add + max) ~ 2*log2(K) ops, one select ~= 8 + 2*log2(K)
+    ops = cells * (8 + 2 * math.log2(K))
+    return {"bytes": float(tab + band + moves), "ops": float(ops),
+            "tab_bytes": float(tab), "band_bytes": float(band),
+            "moves_bytes": float(moves)}
+
+
+def dense_model(T1p: int, K: int, Npad: int, C: int) -> Dict[str, float]:
+    """HBM bytes + VPU ops for the dense candidate-tables kernel: reads
+    the forward half of the band, the halo-blocked backward band
+    (written by the halo program then read), the 5 forward tables
+    again; writes the [T1p, 16, Npad] per-column join maxima."""
+    n_steps = T1p // C
+    CB = C + K
+    bh = n_steps * (C + 1) * K * Npad * _F32
+    rd = K * T1p * Npad * _F32 + bh + 5 * n_steps * CB * Npad * _F32
+    out = T1p * 16 * Npad * _F32
+    # per column per base: 2 scans + joins over K rows, 9 outputs
+    ops = T1p * Npad * K * (8 * (4 + 2 * math.log2(K)) + 10)
+    return {"bytes": float(rd + out + bh), "ops": float(ops),
+            "halo_bytes": float(bh)}
+
+
+def stats_model(
+    T1p: int, K: int, Npad: int, C: int, moves_itemsize: int = 4,
+) -> Dict[str, float]:
+    """HBM bytes + VPU ops for the reverse-sweep stats kernel: reads
+    the move band once (int32 from the fused layout, int8 from the
+    panel store), the blocked read-base table once, and writes the
+    [T1p, 16, Npad] per-column edit tiles plus an 8-row accumulator."""
+    n_steps = T1p // C
+    CB = C + K
+    moves = K * T1p * Npad * moves_itemsize
+    sq = n_steps * CB * Npad * _F32
+    tiles = T1p * 16 * Npad * _F32
+    acc = 8 * Npad * _F32
+    # per cell: decode + on-path closure (two log-K scans) + indicator
+    # joins ~= 10 + 4*log2(K)
+    ops = K * T1p * Npad * (10 + 4 * math.log2(K))
+    return {"bytes": float(moves + sq + tiles + acc), "ops": float(ops),
+            "moves_bytes": float(moves), "tiles_bytes": float(tiles)}
+
+
+def fused_model(
+    T1p: int,
+    K: int,
+    Npad: int,
+    C: int,
+    want_stats: bool = False,
+    stats_itemsize: int = 4,
+) -> Dict[str, float]:
+    """One fused consensus step: two-stream fill + backward halo +
+    dense tables, plus — with ``want_stats`` — the move-band write and
+    the reverse stats sweep."""
+    f = fill_model(T1p, K, Npad, C, n_streams=2, want_moves=want_stats,
+                   moves_lanes=2 * Npad)
+    d = dense_model(T1p, K, Npad, C)
+    total = f["bytes"] + d["bytes"]
+    ops = f["ops"] + d["ops"]
+    parts = {"fill": f, "dense": d}
+    if want_stats:
+        s = stats_model(T1p, K, Npad, C, moves_itemsize=stats_itemsize)
+        total += s["bytes"]
+        ops += s["ops"]
+        parts["stats"] = s
+    return {"bytes": float(total), "ops": float(ops), "parts": parts}
+
+
+def utilization(nbytes: float, seconds: float) -> Dict[str, float]:
+    """Achieved bandwidth and fraction of the HBM roof."""
+    if seconds <= 0:
+        return {"gbps": 0.0, "pct_hbm": 0.0}
+    gbps = nbytes / 1e9 / seconds
+    return {"gbps": gbps, "pct_hbm": 100.0 * gbps / HBM_GBPS}
+
+
+# ---- per-call registry -----------------------------------------------------
+# Non-jit wrappers (engine.realign, the panel driver, bench) record the
+# block plan + modelled traffic of each dispatch here; jit bodies trace
+# once, so recording must happen OUTSIDE them. Bounded so long sweeps
+# don't grow host memory; snapshot() drains a copy for reporting.
+
+_MAX_RECORDS = 256
+_records: List[Dict] = []
+_lock = threading.Lock()
+
+
+def record(kernel: str, **fields) -> None:
+    """Append one dispatch record ({"kernel": ..., **fields}); keeps
+    only the most recent _MAX_RECORDS."""
+    entry = {"kernel": kernel}
+    entry.update(fields)
+    with _lock:
+        _records.append(entry)
+        if len(_records) > _MAX_RECORDS:
+            del _records[: len(_records) - _MAX_RECORDS]
+
+
+def snapshot() -> List[Dict]:
+    """Copy of the current records, oldest first."""
+    with _lock:
+        return [dict(r) for r in _records]
+
+
+def clear() -> None:
+    with _lock:
+        _records.clear()

@@ -1,4 +1,4 @@
-"""Shared shape-grid helpers.
+"""Shared shape-grid helpers and the VMEM block planner.
 
 Every device program in this repo is compiled for PADDED shapes drawn
 from a fixed grid — template columns to `len_bucket` multiples, band
@@ -8,9 +8,150 @@ recompiling (engine.realign module docstring). These helpers are the
 single definition of that rounding; engine.realign,
 ops.align_codon_jax, and parallel.sweep_sharded all import them
 (three private copies existed before).
+
+`plan_cols` is the single VMEM budgeter for every Pallas kernel's
+columns-per-grid-step choice (it replaces the private
+`fill_pallas._pick_cols` and `dense_pallas.pick_dense_cols` copies):
+each kernel declares its double-buffered per-grid-step working set in
+[rows, 128]-lane f32 tiles, and the planner picks the largest
+power-of-two divisor of T1p that fits the budget. The returned
+BlockPlan carries the sizing model alongside the choice so callers
+(engine.realign, bench, exp/roofline) can record WHY a block shape was
+chosen, not just what it was.
 """
 
 from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence
+
+LANES = 128
+_STAT_ROWS = 16  # dense/stats per-column output rows (dense_pallas.ROWS)
+
+# per-kernel column caps: fill streams whole output blocks so it can
+# afford wide steps; dense is capped at T1p // 2 so the backward halo
+# slice (C + 1 columns) always fits inside the band; stats matches fill
+# (it re-reads the fill's blocked tables at the fill's block shape).
+_COL_CAPS = {
+    "fill": lambda T1p: min(T1p, 512),
+    "dense": lambda T1p: min(T1p // 2, 256),
+    "stats": lambda T1p: min(T1p, 512),
+}
+
+
+class BlockPlan(NamedTuple):
+    """One kernel's chosen VMEM blocking, plus the model behind it."""
+
+    kernel: str  # "fill" | "dense" | "stats"
+    T1p: int  # padded template columns
+    K: int  # uniform band height
+    cols: int  # columns per grid step (the choice)
+    n_steps: int  # T1p // cols
+    vmem_bytes: int  # modelled double-buffered working set at `cols`
+    vmem_budget: int  # the budget it was fit under
+
+
+def _block_rows(kernel: str, c: int, K: int, want_moves: bool) -> int:
+    """Double-buffered per-grid-step working set of one kernel at block
+    width ``c``, in [rows, 128] f32 tiles (multiply by 2*128*4 for
+    bytes). These formulas ARE the sizing model — they deliberately
+    reproduce the historical per-module pickers bit-for-bit so hoisting
+    the planner changes no compiled shape."""
+    if kernel == "fill":
+        # output band block [C*K, 128] (twice with a move-band output)
+        # + 5 halo'd table blocks [C+K, 128]
+        out_blocks = 2 if want_moves else 1
+        return out_blocks * c * K + 5 * (c + K)
+    if kernel == "dense":
+        # A block C*K + B halo (C+1)*K + 5 tables (C+K) + out C*ROWS
+        return c * K + (c + 1) * K + 5 * (c + K) + c * _STAT_ROWS
+    if kernel == "stats":
+        # moves block C*K (int8 input still budgeted as f32: the kernel
+        # widens on load) + seq table block (C+K) + out tiles C*16
+        return c * K + (c + K) + c * _STAT_ROWS
+    raise ValueError(f"unknown kernel: {kernel!r}")
+
+
+def plan_cols(
+    T1p: int,
+    K: int,
+    kernel: str = "fill",
+    want_moves: bool = False,
+    vmem_budget: int = 9 << 20,
+) -> BlockPlan:
+    """Pick columns-per-grid-step for one Pallas kernel: the largest
+    power-of-two divisor of ``T1p`` (>= 1, under the kernel's cap)
+    whose double-buffered working set fits ``vmem_budget`` bytes. T1p
+    is a multiple of 64 for bucketed templates, so powers of two up to
+    64 always divide it. Monotone in the budget: a larger budget never
+    yields fewer columns (tests/test_shapes_planner.py)."""
+    cap = _COL_CAPS[kernel](T1p)
+    best = 1
+    c = 1
+    while c <= cap:
+        if T1p % c == 0:
+            need = 2 * LANES * 4 * _block_rows(kernel, c, K, want_moves)
+            if need <= vmem_budget:
+                best = c
+        c *= 2
+    return BlockPlan(
+        kernel=kernel,
+        T1p=T1p,
+        K=K,
+        cols=best,
+        n_steps=T1p // best,
+        vmem_bytes=2 * LANES * 4 * _block_rows(kernel, best, K, want_moves),
+        vmem_budget=vmem_budget,
+    )
+
+
+class LanePacking(NamedTuple):
+    """Length-sorted assignment of reads to 128-lane tiles.
+
+    The uniform band frame sizes every lane's DP band by the GLOBAL
+    (K, T1p), so a tile mixing a 200 bp read with 3 kb neighbours
+    moves the 3 kb tile's bytes for everyone. Packing reads into tiles
+    by descending length makes each tile's max length (and hence the
+    bytes a length-aware layout must move for it) tight. This is the
+    ACCOUNTING for that packing — callers sort/report with it (the
+    sweep planner's occupancy stats, the roofline layer); the driver's
+    read order itself is unchanged, keeping results bit-identical."""
+
+    order: List[int]  # read indices, length-descending (stable)
+    inverse: List[int]  # inverse permutation: orig idx -> packed slot
+    n_tiles: int  # ceil(n_reads / lanes)
+    tile_max: List[int]  # max length per packed tile
+    occupancy: float  # useful cells / packed per-tile-max cells
+    uniform_occupancy: float  # useful cells / global-max cells
+
+
+def pack_lanes(lengths: Sequence[int], lanes: int = LANES) -> LanePacking:
+    """Pack reads into ``lanes``-wide tiles by descending length and
+    report the padded-cell occupancy of the packed layout vs the
+    uniform (pad-everything-to-global-max) layout."""
+    lens = [int(x) for x in lengths]
+    n = len(lens)
+    if n == 0:
+        return LanePacking([], [], 0, [], 1.0, 1.0)
+    order = sorted(range(n), key=lambda i: (-lens[i], i))
+    inverse = [0] * n
+    for slot, i in enumerate(order):
+        inverse[i] = slot
+    n_tiles = (n + lanes - 1) // lanes
+    tile_max = [
+        max(lens[i] for i in order[t * lanes : (t + 1) * lanes])
+        for t in range(n_tiles)
+    ]
+    useful = sum(lens)
+    packed_cells = sum(m * lanes for m in tile_max)
+    uniform_cells = n_tiles * lanes * max(lens)
+    return LanePacking(
+        order=order,
+        inverse=inverse,
+        n_tiles=n_tiles,
+        tile_max=tile_max,
+        occupancy=useful / packed_cells if packed_cells else 1.0,
+        uniform_occupancy=useful / uniform_cells if uniform_cells else 1.0,
+    )
 
 
 def bucket(n: int, b: int) -> int:

@@ -168,6 +168,31 @@ def test_do_score_quality_estimation():
     assert result.aln_error_probs.shape == (L,)
 
 
+def test_estimate_probs_table_readout_matches_proposal_loop(monkeypatch):
+    """The SCORE-stage whole-table readout (aligner.dense_score_tables)
+    must equal the legacy one-proposal-at-a-time scoring loop exactly —
+    identity-substitution slots included."""
+    from rifraf_tpu.engine import driver as driver_mod
+
+    rng = np.random.default_rng(11)
+    (ref, template, t_p, seqs, actual, phreds, cb, db) = sample_sequences(
+        nseqs=5, length=25, error_rate=0.03, rng=rng
+    )
+    params = RifrafParams(do_score=True, seed=3)
+    result = rifraf(seqs, phreds=phreds, params=params)
+    state = result.state
+    assert state.aligner.dense_score_tables(len(state.consensus)) is not None
+    fast = driver_mod.estimate_probs(state, params)
+    monkeypatch.setattr(
+        type(state.aligner), "dense_score_tables",
+        lambda self, tlen: None,
+    )
+    slow = driver_mod.estimate_probs(state, params)
+    np.testing.assert_array_equal(fast.sub, slow.sub)
+    np.testing.assert_array_equal(fast.dele, slow.dele)
+    np.testing.assert_array_equal(fast.ins, slow.ins)
+
+
 @pytest.mark.parametrize(
     "consensus,reference,expected",
     [

@@ -1,0 +1,162 @@
+"""Tests for the shared VMEM block planner and lane-packing accounting
+(utils.shapes) — the single budgeter that replaced the private
+fill_pallas._pick_cols / dense_pallas.pick_dense_cols copies."""
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.utils import roofline
+from rifraf_tpu.utils.shapes import (
+    LANES,
+    pack_lanes,
+    plan_cols,
+    pow2_bucket,
+)
+
+T1PS = [64, 128, 256, 512, 1088, 4096]
+KS = [16, 32, 64, 128]
+KERNELS = ["fill", "dense", "stats"]
+
+
+def _legacy_fill_cols(T1p, K, want_moves=False, budget=9 << 20):
+    """fill_pallas._pick_cols as shipped before the hoist (verbatim
+    formulas) — the planner must reproduce it bit-for-bit."""
+    out_blocks = 2 if want_moves else 1
+    best = 1
+    c = 1
+    while c <= min(T1p, 512):
+        if T1p % c == 0 and 2 * 128 * 4 * (
+            out_blocks * c * K + 5 * (c + K)
+        ) <= budget:
+            best = c
+        c *= 2
+    return best
+
+
+def _legacy_dense_cols(T1p, K, budget=9 << 20):
+    """dense_pallas.pick_dense_cols as shipped before the hoist."""
+    best = 1
+    c = 1
+    while c <= min(T1p // 2, 256):
+        if T1p % c == 0 and 2 * 128 * 4 * (
+            c * K + (c + 1) * K + 5 * (c + K) + c * 16
+        ) <= budget:
+            best = c
+        c *= 2
+    return best
+
+
+@pytest.mark.parametrize("T1p", T1PS)
+@pytest.mark.parametrize("K", KS)
+@pytest.mark.parametrize("want_moves", [False, True])
+def test_planner_reproduces_legacy_fill(T1p, K, want_moves):
+    plan = plan_cols(T1p, K, kernel="fill", want_moves=want_moves)
+    assert plan.cols == _legacy_fill_cols(T1p, K, want_moves)
+
+
+@pytest.mark.parametrize("T1p", T1PS)
+@pytest.mark.parametrize("K", KS)
+def test_planner_reproduces_legacy_dense(T1p, K):
+    plan = plan_cols(T1p, K, kernel="dense")
+    assert plan.cols == _legacy_dense_cols(T1p, K)
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+@pytest.mark.parametrize("T1p", T1PS)
+@pytest.mark.parametrize("K", KS)
+def test_budget_monotonicity(kernel, T1p, K):
+    """A larger VMEM budget never yields fewer columns."""
+    budgets = [1 << 18, 1 << 20, 9 << 20, 1 << 25, 1 << 28]
+    cols = [
+        plan_cols(T1p, K, kernel=kernel, vmem_budget=b).cols
+        for b in budgets
+    ]
+    assert cols == sorted(cols)
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+@pytest.mark.parametrize("T1p", T1PS)
+@pytest.mark.parametrize("K", KS)
+def test_hard_vmem_bound(kernel, T1p, K):
+    """Whenever ANY block width fits the budget, the chosen one does
+    (best=1 is the forced floor when nothing fits)."""
+    # working set at the c=1 floor (budget 0 forces best=1)
+    min_need = plan_cols(T1p, K, kernel=kernel, vmem_budget=0).vmem_bytes
+    cap_cols = plan_cols(T1p, K, kernel=kernel, vmem_budget=1 << 62).cols
+    for budget in (1 << 18, 1 << 20, 9 << 20, 1 << 25):
+        plan = plan_cols(T1p, K, kernel=kernel, vmem_budget=budget)
+        if min_need <= budget:
+            assert plan.vmem_bytes <= budget
+        assert plan.cols <= cap_cols
+        assert T1p % plan.cols == 0
+        assert plan.n_steps * plan.cols == T1p
+
+
+def test_plan_fields_consistent():
+    plan = plan_cols(1088, 32, kernel="dense")
+    assert plan.kernel == "dense"
+    assert plan.T1p == 1088 and plan.K == 32
+    assert plan.vmem_budget == 9 << 20
+    assert plan.cols >= 1 and plan.vmem_bytes > 0
+
+
+def test_pack_lanes_accounting():
+    rng = np.random.default_rng(0)
+    lens = rng.integers(50, 3000, size=300).tolist()
+    pk = pack_lanes(lens)
+    # a permutation, with a correct inverse
+    assert sorted(pk.order) == list(range(300))
+    for i, slot in enumerate(pk.inverse):
+        assert pk.order[slot] == i
+    assert pk.n_tiles == (300 + LANES - 1) // LANES
+    # packed tiles are length-descending, so tile maxima never increase
+    assert pk.tile_max == sorted(pk.tile_max, reverse=True)
+    assert pk.tile_max[0] == max(lens)
+    # packing can only help: packed occupancy >= uniform, both in (0, 1]
+    assert 0.0 < pk.uniform_occupancy <= pk.occupancy <= 1.0
+
+
+def test_pack_lanes_uniform_lengths_full():
+    pk = pack_lanes([100] * 256)
+    assert pk.occupancy == 1.0 and pk.uniform_occupancy == 1.0
+    assert pack_lanes([]).n_tiles == 0
+
+
+def test_pow2_bucket():
+    assert [pow2_bucket(n) for n in (0, 1, 2, 3, 5, 8, 9)] == [
+        1, 1, 2, 4, 8, 8, 16,
+    ]
+
+
+def test_roofline_models_positive_and_additive():
+    """The fused model is the sum of its parts, and stats rides on top
+    only when requested."""
+    T1p, K, Npad, C = 1088, 32, 2048, 32
+    f = roofline.fill_model(T1p, K, Npad, C, n_streams=2,
+                            want_moves=True, moves_lanes=2 * Npad)
+    d = roofline.dense_model(T1p, K, Npad, C)
+    s = roofline.stats_model(T1p, K, Npad, C)
+    base = roofline.fused_model(T1p, K, Npad, C)
+    full = roofline.fused_model(T1p, K, Npad, C, want_stats=True)
+    assert full["bytes"] == pytest.approx(
+        f["bytes"] + d["bytes"] + s["bytes"]
+    )
+    assert full["bytes"] > base["bytes"] > 0
+    assert full["ops"] > base["ops"] > 0
+    # int8 panel moves shrink the stats read 4x
+    s8 = roofline.stats_model(T1p, K, Npad, C, moves_itemsize=1)
+    assert s8["moves_bytes"] * 4 == pytest.approx(s["moves_bytes"])
+
+
+def test_roofline_utilization_and_registry():
+    u = roofline.utilization(roofline.HBM_GBPS * 1e9, 1.0)
+    assert u["pct_hbm"] == pytest.approx(100.0)
+    assert roofline.utilization(1e9, 0.0) == {"gbps": 0.0, "pct_hbm": 0.0}
+    roofline.clear()
+    for i in range(300):
+        roofline.record("fused_step", i=i, model_bytes=1.0)
+    snap = roofline.snapshot()
+    assert len(snap) == 256  # bounded
+    assert snap[-1]["i"] == 299 and snap[0]["i"] == 44
+    roofline.clear()
+    assert roofline.snapshot() == []

@@ -1,0 +1,195 @@
+"""Bit-identity tests for the on-core reverse-sweep stats kernel.
+
+ops.stats_pallas consumes the fill kernel's in-kernel move codes in the
+uniform band frame and must reproduce dense_pallas.stats_from_moves —
+the XLA moves-scan oracle (itself oracle-tested against the vmapped
+host traceback) — EXACTLY: same n_errors, same per-column edit
+indicator table, across band geometries (read-length spread, bandwidth
+growth, short-vs-long lane mixes), in both the single-launch int32
+layout and the int8 panel-store layout. The kernels run in Pallas
+interpret mode here (the suite forces the CPU backend), so tracing is
+slow and the sweep tests are marked slow; the CI `kernels` job runs
+them explicitly.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax, dense_pallas, fill_pallas, stats_pallas
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+
+
+def _problem(tlen=24, n_reads=4, bw=5, seed=3, spread=5):
+    rng = np.random.default_rng(seed)
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n_reads):
+        slen = int(rng.integers(max(4, tlen - spread), tlen + spread + 1))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -1.0, size=slen)
+        reads.append(make_read_scores(s, log_p, bw, SCORES))
+    return template, batch_reads(reads, dtype=np.float32)
+
+
+def _setup(template, batch):
+    tlen = len(template)
+    geom = align_jax.batch_geometry(batch, tlen)
+    K = fill_pallas.uniform_band_height(
+        np.asarray(geom.offset), np.asarray(geom.nd)
+    )
+    Tmax = ((tlen + 63) // 64) * 64
+    T1p = Tmax + 64
+    tpl = np.zeros(Tmax, np.int8)
+    tpl[:tlen] = template
+    Npad = ((batch.n_reads + 127) // 128) * 128
+    bufs = fill_pallas.build_fill_buffers(
+        jnp.asarray(batch.seq), jnp.asarray(batch.match),
+        jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+        jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
+    )
+    return tlen, geom, K, Tmax, T1p, tpl, Npad, bufs
+
+
+def _oracle_and_kernel(template, batch, C):
+    """Run one forward fill with move recording, then both stats
+    engines on the SAME move band; returns (oracle, kernel) pairs."""
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs = _setup(template, batch)
+    T1 = Tmax + 1
+    p = fill_pallas.prepare_fill(
+        jnp.asarray(tpl), jnp.int32(tlen), bufs, geom, K, T1p, C,
+        with_backward=True,
+    )
+    NB = Npad // fill_pallas.LANES
+    _, _, moves_flat = fill_pallas._fill_call(
+        p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
+        K=K, T1p=T1p, NBLK=2 * NB, C=C, want_moves=True, interpret=True,
+    )
+    moves = dense_pallas._moves_band(moves_flat, K, T1p, Npad)
+    nerr_x, edits_x = dense_pallas.stats_from_moves(
+        moves[:, :, :T1], bufs.seq_T.T, jnp.asarray(tpl), geom,
+        bufs.lengths, K,
+    )
+    nerr_p, edits_p = stats_pallas.traceback_stats_pallas(
+        p, moves_flat, K, T1p, C, Npad, T1, interpret=True,
+    )
+    return (nerr_x, edits_x), (nerr_p, edits_p), (p, moves_flat, K, T1p,
+                                                  Npad, T1)
+
+
+# length spread, bandwidth growth, block widths, and a wide short/long
+# lane mix — the geometries the uniform frame must mask correctly
+GEOMETRIES = [
+    dict(tlen=24, n_reads=4, bw=5, seed=3, spread=5, C=8),
+    dict(tlen=16, n_reads=3, bw=4, seed=11, spread=5, C=4),
+    dict(tlen=40, n_reads=6, bw=4, seed=13, spread=5, C=16),
+    dict(tlen=30, n_reads=5, bw=8, seed=21, spread=12, C=8),
+    dict(tlen=48, n_reads=7, bw=6, seed=5, spread=30, C=8),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("cfg", GEOMETRIES,
+                         ids=[f"g{i}" for i in range(len(GEOMETRIES))])
+def test_stats_kernel_bit_identical_to_xla(cfg):
+    cfg = dict(cfg)
+    C = cfg.pop("C")
+    template, batch = _problem(**cfg)
+    (nerr_x, edits_x), (nerr_p, edits_p), _ = _oracle_and_kernel(
+        template, batch, C
+    )
+    np.testing.assert_array_equal(np.asarray(nerr_p), np.asarray(nerr_x))
+    np.testing.assert_array_equal(np.asarray(edits_p),
+                                  np.asarray(edits_x))
+
+
+@pytest.mark.slow
+def test_stats_kernel_nerr_only_path():
+    """want_edits=False (the adapt round's shape) must agree on n_errors
+    and return no edits table."""
+    template, batch = _problem()
+    (nerr_x, _), _, (p, moves_flat, K, T1p, Npad, T1) = (
+        _oracle_and_kernel(template, batch, 8)
+    )
+    nerr, edits = stats_pallas.traceback_stats_pallas(
+        p, moves_flat, K, T1p, 8, Npad, T1, want_edits=False,
+        interpret=True,
+    )
+    assert edits is None
+    np.testing.assert_array_equal(np.asarray(nerr), np.asarray(nerr_x))
+
+
+@pytest.mark.slow
+def test_fused_stats_env_opt_out_identical(monkeypatch):
+    """fused_tables_pallas(want_stats=True) must produce identical
+    n_errors/edits whether the stats step runs on-core (default) or on
+    the XLA moves-scan path (RIFRAF_TPU_STATS_IMPL=xla)."""
+    template, batch = _problem(tlen=24, n_reads=4, bw=5, seed=7)
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs = _setup(template, batch)
+    weights = jnp.ones(batch.n_reads, jnp.float32)
+
+    def run():
+        return dense_pallas.fused_tables_pallas(
+            jnp.asarray(tpl), jnp.int32(tlen), bufs, geom, weights,
+            K, T1p, 8, want_stats=True, interpret=True,
+        )
+
+    monkeypatch.delenv("RIFRAF_TPU_STATS_IMPL", raising=False)
+    assert stats_pallas.use_pallas_stats()
+    on_core = run()
+    monkeypatch.setenv("RIFRAF_TPU_STATS_IMPL", "xla")
+    assert not stats_pallas.use_pallas_stats()
+    xla = run()
+    np.testing.assert_array_equal(np.asarray(on_core["n_errors"]),
+                                  np.asarray(xla["n_errors"]))
+    np.testing.assert_array_equal(np.asarray(on_core["edits"]),
+                                  np.asarray(xla["edits"]))
+    # the non-stats tables must be untouched by the stats engine choice
+    np.testing.assert_array_equal(np.asarray(on_core["total"]),
+                                  np.asarray(xla["total"]))
+
+
+@pytest.mark.slow
+def test_panel_stats_int8_matches_single_launch():
+    """The panel path re-reads the stored int8 move band; its chained
+    reverse-carry sweep must equal the single-launch int32 kernel."""
+    template, batch = _problem(tlen=40, n_reads=3, bw=4, seed=13)
+    tlen, geom, K, Tmax, T1p, tpl, Npad, bufs = _setup(template, batch)
+    assert stats_pallas.int8_moves_ok(K, 8)
+    weights = jnp.ones(batch.n_reads, jnp.float32)
+    one = dense_pallas.fused_tables_pallas(
+        jnp.asarray(tpl), jnp.int32(tlen), bufs, geom, weights,
+        K, T1p, 8, want_stats=True, interpret=True,
+    )
+    pan = dense_pallas.fused_tables_pallas_panels(
+        jnp.asarray(tpl), jnp.int32(tlen), bufs, geom, weights,
+        K, T1p, 8, panel_cols=16, want_stats=True, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(pan["n_errors"]),
+                                  np.asarray(one["n_errors"]))
+    np.testing.assert_array_equal(np.asarray(pan["edits"]),
+                                  np.asarray(one["edits"]))
+
+
+def test_int8_moves_tile_guard():
+    """The panel stats kernel loads int8 moves as (C*K, 128) blocks;
+    int8 tiles need 32-row multiples."""
+    assert stats_pallas.int8_moves_ok(16, 8)  # 128 rows
+    assert stats_pallas.int8_moves_ok(24, 8)  # 192 rows
+    assert stats_pallas.int8_moves_ok(8, 4)  # 32 rows
+    assert not stats_pallas.int8_moves_ok(8, 1)  # 8 rows
+    assert not stats_pallas.int8_moves_ok(24, 1)  # 24 rows
+
+
+def test_use_pallas_stats_env_switch(monkeypatch):
+    monkeypatch.delenv("RIFRAF_TPU_STATS_IMPL", raising=False)
+    assert stats_pallas.use_pallas_stats()
+    monkeypatch.setenv("RIFRAF_TPU_STATS_IMPL", "pallas")
+    assert stats_pallas.use_pallas_stats()
+    monkeypatch.setenv("RIFRAF_TPU_STATS_IMPL", "xla")
+    assert not stats_pallas.use_pallas_stats()

@@ -53,24 +53,37 @@ _BYTES_PER_CELL = 22  # A+B f32, moves int8, ~2 transient copies
 
 
 def _dense_cols(T1p: int, K: int, Npad: int = 0,
-                want_stats: bool = False) -> int:
+                want_stats: bool = False, impl: str = "split",
+                n_live: int = 0) -> int:
     """Column block for the fused/dense Pallas dispatches via the shared
     VMEM planner (utils.shapes.plan_cols), recording the block plan and
     modelled HBM traffic so bench/diagnostics can report roofline
-    utilization per dispatch. Interpret mode (CPU tests) pins C=8 to
-    keep the traced kernel body bounded."""
+    utilization per dispatch. ``impl`` is the routing decision from
+    ops.fused_pallas.select_impl: the megakernel plans under
+    kernel="fused" and its single-launch byte model (band bytes counted
+    once). ``n_live`` (real reads in the batch, vs the Npad lane
+    padding) adds the dispatch's lane occupancy to the record — a
+    5-read reference-default batch fills 5/128 of the lane axis, and
+    every modelled byte is spent on the padded shape. Interpret mode
+    (CPU tests) pins C=8 to keep the traced kernel body bounded."""
     from ..utils import roofline
     from ..utils.shapes import plan_cols
 
-    plan = plan_cols(T1p, K, kernel="dense")
+    plan = plan_cols(T1p, K, kernel="fused" if impl == "mega" else "dense",
+                     want_moves=impl == "mega" and want_stats)
     C = 8 if _pallas_interpret() else plan.cols
     if Npad:
-        model = roofline.fused_model(T1p, K, Npad, C,
-                                     want_stats=want_stats)
+        if impl == "mega":
+            model = roofline.fused_mega_model(T1p, K, Npad, C,
+                                              want_stats=want_stats)
+        else:
+            model = roofline.fused_model(T1p, K, Npad, C,
+                                         want_stats=want_stats)
         roofline.record(
-            "fused_step", T1p=T1p, K=K, Npad=Npad, C=C,
+            "fused_step", T1p=T1p, K=K, Npad=Npad, C=C, impl=impl,
             vmem_bytes=plan.vmem_bytes, model_bytes=model["bytes"],
             model_ops=model["ops"], want_stats=want_stats,
+            lane_occupancy=(n_live / Npad) if n_live else None,
         )
     return C
 
@@ -404,14 +417,18 @@ class BatchAligner:
         import jax.numpy as jnp
 
         from ..ops import align_jax
-        from ..ops.dense_pallas import fused_step_pallas
+        from ..ops.fused_pallas import fused_step_auto, select_impl
 
         T = len(t)
         T1 = T + 1
         T1p = _bucket(T1, 64)
         K = self._pallas_K(tlen)
+        # the mesh path shards its own 3-launch pipeline; route it split
+        impl = "split" if self.mesh is not None else select_impl(
+            T1p, K, want_stats=want_stats, want_moves=want_moves)[0]
         C = _dense_cols(T1p, K, _bucket(self.batch.n_reads, 128),
-                        want_stats=want_stats)
+                        want_stats=want_stats, impl=impl,
+                        n_live=self.batch.n_reads)
         bufs = self._ensure_fill_bufs()
         batch = self._current_batch()
         self.n_forward_fills += 1
@@ -433,11 +450,11 @@ class BatchAligner:
             geom = align_jax.batch_geometry(batch, tlen)
             weights = jnp.ones(self.batch.n_reads, dtype=jnp.float32)
             with self.timers.time("fused_dispatch"):
-                packed, moves_dev = fused_step_pallas(
+                packed, moves_dev = fused_step_auto(
                     jnp.asarray(t, jnp.int8), jnp.int32(tlen), bufs, geom,
                     weights, K, T1p, C,
                     want_stats=want_stats, want_moves=want_moves,
-                    interpret=_pallas_interpret(),
+                    interpret=_pallas_interpret(), impl=impl,
                 )
             Npad = bufs.seq_T.shape[1]
             slots = np.arange(self.batch.n_reads)
@@ -584,23 +601,33 @@ class BatchAligner:
         # not be reused (its band would silently truncate)
         K = (self._pallas_K(tlen0, margin=MAX_DRIFT) if use_pallas
              else _bucket(self._K(tlen0) + MAX_DRIFT, 8))
+        T1 = Tmax + 1
+        T1p = _bucket(T1, 64)
+        # fused-step routing is part of the runner's identity: a runner
+        # compiled for the megakernel must not be served after the env
+        # flips to split (and vice versa)
+        impl = "split"
+        if use_pallas:
+            from ..ops.fused_pallas import select_impl
+
+            impl = select_impl(T1p, K, want_stats=use_edits)[0]
         key = (Tmax, K, use_pallas, do_indels, min_dist, history_cap,
-               stop_on_same, use_edits)
+               stop_on_same, use_edits, impl)
         if key in self._stage_runners:
             return self._stage_runners[key]
 
         n_reads = self.batch.n_reads
-        T1 = Tmax + 1
-        T1p = _bucket(T1, 64)
         bw_dev = jnp.asarray(self.bandwidths)
         lengths_dev = jnp.asarray(self._lengths_host)
 
         if use_pallas:
-            C = _dense_cols(T1p, K)
+            C = _dense_cols(T1p, K, _bucket(n_reads, 128),
+                            want_stats=use_edits, impl=impl,
+                            n_live=n_reads)
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_stage_runner(
                 K, T1p, C, do_indels, min_dist,
-                history_cap, Tmax, stop_on_same, use_edits,
+                history_cap, Tmax, stop_on_same, use_edits, impl,
             )
             state = (self._ensure_fill_bufs(), lengths_dev, bw_dev, weights)
         else:
@@ -675,16 +702,21 @@ class BatchAligner:
         # (the same hazard align_codon_jax._ENGINE_CACHE guards). The
         # skewed tables derive from the same engine, so the rt identity
         # check covers them too.
+        T1 = Tmax + 1
+        T1p = _bucket(T1, 64)
+        impl = "split"
+        if use_pallas:
+            from ..ops.fused_pallas import select_impl
+
+            impl = select_impl(T1p, K)[0]
         key = ("frame", Tmax, K, use_pallas, do_subs, min_dist,
                history_cap, stop_on_same, Kc, T1pc, nrows, ref.bandwidth,
-               seed_gate)
+               seed_gate, impl)
         hit = self._stage_runners.get(key)
         if hit is not None and hit[0] is rt:
             return hit[1]
 
         n_reads = self.batch.n_reads
-        T1 = Tmax + 1
-        T1p = _bucket(T1, 64)
         bw_dev = jnp.asarray(self.bandwidths)
         lengths_dev = jnp.asarray(self._lengths_host)
         rt9 = tuple(rt[:9])
@@ -692,12 +724,13 @@ class BatchAligner:
             rt9s = tuple(eng._tables(ref.bandwidth, True)[:9])
 
         if use_pallas:
-            C = _dense_cols(T1p, K)
+            C = _dense_cols(T1p, K, _bucket(n_reads, 128), impl=impl,
+                            n_live=n_reads)
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_frame_runner(
                 K, T1p, C, True, do_subs, min_dist, history_cap, Tmax,
                 stop_on_same, Kc, T1pc, nrows, rt.do_cins, rt.do_cdel,
-                seed_gate,
+                seed_gate, impl,
             )
             read_state = (self._ensure_fill_bufs(), lengths_dev, bw_dev,
                           weights)
@@ -1184,12 +1217,15 @@ def _frame_seed_gates(tmpl, tlen, rt9s, Kc: int, T1pc: int, nrows: int,
 @functools.lru_cache(maxsize=32)
 def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
                          history_cap, Tmax, stop_on_same, Kc, T1pc, nrows,
-                         do_cins, do_cdel, seed_gate=False):
+                         do_cins, do_cdel, seed_gate=False, impl="split"):
     """Compiled device FRAME stage loop: Pallas read step + codon-engine
     reference tables. step_state = ((FillBuffers, lengths, bandwidths,
-    weights), rt_arrays[, skewed rt_arrays])."""
+    weights), rt_arrays[, skewed rt_arrays]). ``impl`` is the fused-step
+    routing resolved by the caller (ops.fused_pallas.select_impl) — it
+    sits in the lru_cache key, so flipping RIFRAF_TPU_FUSED_IMPL builds
+    a fresh runner instead of serving a stale trace."""
     from ..ops.align_jax import BandGeometry
-    from ..ops.dense_pallas import fused_tables_pallas
+    from ..ops.fused_pallas import fused_tables_auto
     from .device_loop import make_stage_runner
 
     ref_tables = _frame_ref_tables(Tmax, Kc, T1pc, nrows, do_cins, do_cdel)
@@ -1200,9 +1236,9 @@ def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
         else:
             (bufs, lengths, bw, weights), rt = s
         geom = BandGeometry.make(lengths, tlen, bw)
-        out = fused_tables_pallas(
+        out = fused_tables_auto(
             tmpl, tlen, bufs, geom, weights, K, T1p, C,
-            interpret=_pallas_interpret(),
+            interpret=_pallas_interpret(), impl=impl,
         )
         base = _add_ref_tables(
             (out["total"], out["sub"], out["ins"], out["del"]),
@@ -1219,7 +1255,8 @@ def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
         do_subs=do_subs, gate="seeds" if seed_gate else "none",
-        plan=plan_cols(T1p, K, kernel="dense"),
+        plan=plan_cols(T1p, K,
+                       kernel="fused" if impl == "mega" else "dense"),
     )
 
 
@@ -1267,20 +1304,25 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
 
 @functools.lru_cache(maxsize=64)
 def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
-                         history_cap, Tmax, stop_on_same, use_edits=False):
-    """Compiled device stage loop over the Pallas fill+dense step, shared
+                         history_cap, Tmax, stop_on_same, use_edits=False,
+                         impl="split"):
+    """Compiled device stage loop over the Pallas fused step, shared
     across aligners of identical shape config. step_state =
-    (FillBuffers, lengths, bandwidths, weights)."""
+    (FillBuffers, lengths, bandwidths, weights). ``impl`` routes each
+    step to the single-launch megakernel or the split 3-launch path
+    (resolved by the caller, cached in the key — see
+    _pallas_frame_runner)."""
     from ..ops.align_jax import BandGeometry
-    from ..ops.dense_pallas import fused_tables_pallas
+    from ..ops.fused_pallas import fused_tables_auto
     from .device_loop import make_stage_runner
 
     def step_fn(tmpl, tlen, s):
         bufs, lengths, bw, weights = s
         geom = BandGeometry.make(lengths, tlen, bw)
-        out = fused_tables_pallas(
+        out = fused_tables_auto(
             tmpl, tlen, bufs, geom, weights, K, T1p, C,
             want_stats=use_edits, interpret=_pallas_interpret(),
+            impl=impl,
         )
         base = (out["total"], out["sub"], out["ins"], out["del"])
         if use_edits:
@@ -1292,7 +1334,8 @@ def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
         gate="edits" if use_edits else "none",
-        plan=plan_cols(T1p, K, kernel="dense"),
+        plan=plan_cols(T1p, K,
+                       kernel="fused" if impl == "mega" else "dense"),
     )
 
 

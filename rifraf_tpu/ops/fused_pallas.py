@@ -1,0 +1,920 @@
+"""Single-launch fused megakernel: fill -> dense -> stats in ONE grid.
+
+The split Pallas path (ops.dense_pallas.fused_tables_pallas) runs one
+fused step as three launches — dual-stream fill, dense all-edits
+rescoring, reverse-sweep stats — and round-trips the band tables and
+move codes through HBM between them: the fill WRITES both bands, the
+backward-alignment halo program READS the reversed band and WRITES a
+halo-blocked copy, and the dense kernel READS both again (roofline
+round 5: the band traffic is ~60% of the stats-on step's bytes).
+
+This module chains all three stages under ONE pallas_call so the bands
+and move codes are written once and read once, with no halo copy:
+
+- grid (NB, 2 * n_steps), lane blocks OUTERMOST and both axes
+  "arbitrary": each 128-lane block runs its full phase-1 + phase-2
+  sweep before the next block reuses the shared scratch carry.
+- phase 1 (jb < n_steps): the forward fill (verbatim
+  fill_pallas._fill_kernel math) AND the reversed-problem fill in
+  MIRRORED band coordinates (m = K - 1 - d), both DMA'd per block into
+  per-lane-block ANY scratch ([T1p * K, 128] per band; the compiler
+  places these in HBM, but they are private to the launch — written
+  once, read once, never re-blocked). The forward move codes land in a
+  third int32 scratch when the stats chain is on.
+- phase 2 (jb2 = 2 * n_steps - 1 - jb, i.e. column blocks in REVERSE
+  order, the traceback direction): DMA the forward block back, DMA a
+  (C + 2)-column window of the mirrored reversed band, align it with
+  ONE per-lane binary-decomposed roll (the flip-native layout turns the
+  whole backward-band alignment of dense_pallas.backward_halo_blocks
+  into a cyclic roll), then run the dense kernel math (verbatim
+  _dense_kernel) and, fused behind it, the reverse-sweep stats
+  recurrence (verbatim stats_pallas._stats_kernel) with its P/acc
+  carry in VMEM scratch.
+
+Mirrored reversed fill
+----------------------
+The backward band is B[d, j] = Brev[S_l - d, tlen - j] with
+S_l = slen_l - tlen + 2 * OFF (dense_pallas module docstring). Row
+extraction d -> S_l - d is a FLIP plus per-lane shift — and a flip is
+not a rotation, so it cannot be done on-core with pltpu.roll. Instead
+the reversed fill here runs in mirrored coordinates: scratch row m of
+column jr holds Brev[K - 1 - m, jr], so the flip is baked in at write
+time and phase 2's extraction is the pure per-lane cyclic roll
+rolled[(C + 1 - c) * K + d] = B[d, jb2 * C + c]. Bit-identity with the
+oracle's reversed stream holds because every elementwise op keeps its
+operand order and the suffix doubling scan (stats_pallas._cumop_rev) on
+mirrored data combines EXACTLY the same operand pairs in the same
+order as the prefix scan (fill_pallas._cumop) on unmirrored data:
+step s of either scan computes op(x_here, x_from_s_away) over the same
+association tree. The mirrored table windows come from pre-flipped
+placed buffers (prepare_fused), one (C + K)-row block per grid step —
+the same bytes per step as the split fill's blocked tables.
+
+Selection and the split oracle
+------------------------------
+RIFRAF_TPU_FUSED_IMPL=split pins the 3-launch path (the oracle the CI
+kernels job diffs against; default "mega"). The megakernel DECLINES to
+split automatically when:
+
+- ``want_moves`` (the SCORE-stage host traceback needs the exported
+  move band; the megakernel keeps moves in launch-private scratch);
+- ``plan_cols(T1p, K, "fused", want_moves=want_stats)`` reports the
+  chained per-step working set does not fit the VMEM budget
+  (BlockPlan.fits — the planner always returns cols >= 1, so `fits` is
+  the decline signal);
+- panel mode / mesh sharding (the callers in engine.realign route
+  those to the split/panel paths before reaching the dispatcher).
+
+The int8-panel grids therefore exercise the decline path by
+construction, and fused_tables_auto's outputs are bit-identical to the
+oracle either way (tests/test_fused_pallas.py pins the equality across
+stats-on/off in interpret mode).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# pallas renamed TPUCompilerParams -> CompilerParams across jax releases;
+# accept either so the kernel builds on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+from ..utils.shapes import BlockPlan, plan_cols
+from .align_np import TRACE_DELETE, TRACE_INSERT, TRACE_MATCH, TRACE_NONE
+from .fill_pallas import (
+    LANES,
+    NEG_INF,
+    NEG_LIVE,
+    FillBuffers,
+    _block_tables,
+    _cumop,
+    _pad_lanes,
+)
+from .align_jax import BandGeometry
+from .dense_pallas import ROWS, fused_tables_pallas, pack_parts
+from .stats_pallas import CARRY_ROWS, _cumop_rev, _edits_from_union, _finish_nerr
+
+
+def fused_impl() -> str:
+    """Env selector: RIFRAF_TPU_FUSED_IMPL=split pins the 3-launch
+    oracle; default "mega" (single launch where eligible). Read by the
+    NON-jit dispatchers below, so the choice is resolved per call site,
+    not frozen into a trace cache."""
+    return os.environ.get("RIFRAF_TPU_FUSED_IMPL", "mega")
+
+
+def mega_plan(T1p: int, K: int, want_stats: bool = False,
+              vmem_budget=None) -> BlockPlan:
+    """The megakernel's block plan: kernel="fused", whose per-step set
+    is the max of the phase-1 (dual fill) and phase-2 (dense + stats)
+    working sets; ``want_moves`` position carries want_stats because the
+    move tile only exists when the stats chain is fused in."""
+    kw = {} if vmem_budget is None else {"vmem_budget": int(vmem_budget)}
+    return plan_cols(T1p, K, kernel="fused", want_moves=want_stats, **kw)
+
+
+def mega_eligible(T1p: int, K: int, want_stats: bool = False,
+                  want_moves: bool = False, vmem_budget=None,
+                  impl=None):
+    """(ok, reason) for routing one fused step to the megakernel."""
+    impl = fused_impl() if impl is None else impl
+    if impl == "split":
+        return False, "RIFRAF_TPU_FUSED_IMPL=split"
+    if want_moves:
+        return False, ("want_moves: the host traceback consumes the "
+                       "exported move band; the megakernel keeps moves "
+                       "in launch-private scratch")
+    plan = mega_plan(T1p, K, want_stats=want_stats, vmem_budget=vmem_budget)
+    if not plan.fits:
+        return False, (f"plan_cols(fused): 1-column working set "
+                       f"{plan.vmem_bytes}B exceeds VMEM budget "
+                       f"{plan.vmem_budget}B")
+    return True, "mega"
+
+
+def select_impl(T1p: int, K: int, want_stats: bool = False,
+                want_moves: bool = False, vmem_budget=None, impl=None):
+    """("mega"|"split", reason) — the single routing decision shared by
+    the dispatchers here and engine.realign's roofline recording."""
+    ok, why = mega_eligible(T1p, K, want_stats, want_moves,
+                            vmem_budget=vmem_budget, impl=impl)
+    return ("mega" if ok else "split"), why
+
+
+def mega_cols(T1p: int, K: int, want_stats: bool = False,
+              interpret: bool = False, vmem_budget=None) -> int:
+    """Columns per grid step for the megakernel (interpret mode pins
+    C <= 8 like engine.realign._dense_cols, keeping the traced kernel
+    body bounded for the CPU suite)."""
+    plan = mega_plan(T1p, K, want_stats=want_stats, vmem_budget=vmem_budget)
+    return min(plan.cols, 8) if interpret else plan.cols
+
+
+def prepare_fused(
+    template,  # int8 [Tmax] padded template
+    tlen,  # int32 true length
+    bufs: FillBuffers,
+    geom: BandGeometry,
+    K: int,
+    T1p: int,
+    C: int,
+    off_override=None,
+):
+    """Megakernel inputs: frame scalars, per-lane metadata (the fill
+    AND dense rows in one stack), the forward blocked tables (same
+    placement + blocking as fill_pallas.prepare_fill, so the values the
+    kernel reads are bit-identical to the oracle's), and the MIRRORED
+    reversed-stream tables: the placed reversed buffers row-flipped
+    (f[r] = buf[Lbuf - 1 - r], one zero pad row so every block slice is
+    in bounds) and blocked so that block jb's window for column
+    c = C - 1 - (local offset) yields tileM[m] = buf[j + K - 1 - m] —
+    the value the mirrored fill needs at row m, which is exactly what
+    the oracle's reversed stream reads at row d = K - 1 - m."""
+    Npad = bufs.seq_T.shape[1]
+    n_steps = T1p // C
+    CB = C + K
+
+    tlen = jnp.asarray(tlen, jnp.int32)
+    OFF = (
+        jnp.max(geom.offset).astype(jnp.int32) if off_override is None
+        else jnp.asarray(off_override, jnp.int32)
+    )
+    delta = _pad_lanes((OFF - geom.offset).astype(jnp.int32), Npad)
+    ndv = _pad_lanes(geom.nd.astype(jnp.int32), Npad)
+    slen = bufs.lengths
+    dend = slen - tlen + OFF
+    roff = _pad_lanes(geom.offset.astype(jnp.int32), Npad)
+    bw = _pad_lanes(geom.bandwidth.astype(jnp.int32), Npad)
+
+    L = bufs.seq_T.shape[0]
+    Lbuf = T1p + K + 8
+    Lbig = Lbuf + L
+
+    def place(tab_T, row0, fill):
+        buf = jnp.full((Lbig, Npad), fill, tab_T.dtype)
+        buf = jax.lax.dynamic_update_slice(
+            buf, tab_T, (row0.astype(jnp.int32), jnp.int32(0))
+        )
+        return buf[:Lbuf]
+
+    row_tab = OFF + 1
+    row_dl = OFF
+
+    def fwd(sqT, mtT, mmT, giT, dlT):
+        return (
+            _block_tables(place(mtT, row_tab, 0.0), n_steps, C, CB),
+            _block_tables(place(mmT, row_tab, 0.0), n_steps, C, CB),
+            _block_tables(place(giT, row_tab, 0.0), n_steps, C, CB),
+            _block_tables(place(dlT, row_dl, 0.0), n_steps, C, CB),
+            _block_tables(place(sqT, row_tab, -9), n_steps, C, CB),
+        )
+
+    def _mirror_blocks(buf):
+        # one pad row: the deepest block slice ends at row Lbuf + 1 (its
+        # last row is never read — max in-kernel window row is C + K - 2)
+        f = jnp.concatenate(
+            [buf[::-1], jnp.zeros((1, Npad), buf.dtype)], axis=0
+        )
+        b0 = Lbuf - K - C + 1  # block jb starts at b0 - jb * C (>= 9)
+        return jnp.stack(
+            [f[b0 - jb * C : b0 - jb * C + CB] for jb in range(n_steps)]
+        )
+
+    def rev(sqT, mtT, mmT, giT, dlT):
+        return (
+            _mirror_blocks(place(mtT, row_tab, 0.0)),
+            _mirror_blocks(place(mmT, row_tab, 0.0)),
+            _mirror_blocks(place(giT, row_tab, 0.0)),
+            _mirror_blocks(place(dlT, row_dl, 0.0)),
+            _mirror_blocks(place(sqT, row_tab, -9)),
+        )
+
+    fwd_tabs = fwd(bufs.seq_T, bufs.match_T, bufs.mismatch_T, bufs.ins_T,
+                   bufs.dels_T)
+    rev_tabs = rev(bufs.rseq_T, bufs.rmatch_T, bufs.rmismatch_T,
+                   bufs.rins_T, bufs.rdels_T)
+
+    def to_cols(t):
+        cols = jnp.concatenate([t[:1], t]).astype(jnp.int32)
+        return jnp.pad(cols, (0, T1p - cols.shape[0]))
+
+    k = jnp.arange(template.shape[0])
+    ridx = jnp.clip(tlen - 1 - k, 0, template.shape[0] - 1)
+    rtemplate = jnp.where(k < tlen, template[ridx], template[k])
+
+    return {
+        "tlen_s": jnp.reshape(tlen, (1, 1)),
+        "off_s": jnp.reshape(OFF, (1, 1)),
+        "OFF": OFF,
+        "t_cols": jnp.stack([to_cols(template), to_cols(rtemplate)]),
+        "meta6": jnp.stack(
+            [m[None] for m in (slen, delta, ndv, dend, roff, bw)]
+        ),
+        "fwd_tabs": fwd_tabs,
+        "rev_tabs": rev_tabs,
+    }
+
+
+def _mega_kernel(
+    # SMEM inputs
+    tlen_ref,  # [1, 1]
+    off_ref,  # [1, 1]
+    t_ref,  # [2, T1p] template codes (row 0 forward, row 1 reversed)
+    # per-lane metadata, [1, 1, 128] blocks
+    slen_ref,
+    delta_ref,
+    ndv_ref,
+    dend_ref,
+    roff_ref,
+    bw_ref,
+    # forward blocked tables [1, CB, 128]: phase-1 block jb, phase-2
+    # block jb2 (the dense re-read)
+    fmt_ref,
+    fmm_ref,
+    fgi_ref,
+    fdl_ref,
+    fsq_ref,
+    # mirrored reversed tables [1, CB, 128], phase-1 blocks only
+    rmt_ref,
+    rmm_ref,
+    rgi_ref,
+    rdl_ref,
+    rsq_ref,
+    # outputs: dense [1, 1, C*ROWS, 128], score [1, 128], then with
+    # want_stats tiles [C*ROWS, 128] and acc [CARRY_ROWS, 128]; scratch
+    # per the scratch_shapes list in _mega_call
+    *refs,
+    K: int,
+    C: int,
+    n_steps: int,
+    want_stats: bool,
+):
+    refs = list(refs)
+    dense_ref = refs.pop(0)
+    score_ref = refs.pop(0)
+    tiles_ref = refs.pop(0) if want_stats else None
+    acc_ref = refs.pop(0) if want_stats else None
+    band_f = refs.pop(0)  # ANY [T1p*K, 128] f32, forward band
+    band_r = refs.pop(0)  # ANY [T1p*K, 128] f32, mirrored reversed band
+    stage_f = refs.pop(0)  # VMEM [C*K, 128] f32 (fwd tile / A tile)
+    stage_r = refs.pop(0)  # VMEM [C*K, 128] f32 (rev tile)
+    stage_b = refs.pop(0)  # VMEM [(C+2)*K, 128] f32 (phase-2 B window)
+    sem = refs.pop(0)
+    fcarry = refs.pop(0)  # VMEM [K, 128] f32
+    rcarry = refs.pop(0)  # VMEM [K, 128] f32
+    acc_score = refs.pop(0)  # VMEM [1, 128] f32
+    if want_stats:
+        moves_any = refs.pop(0)  # ANY [T1p*K, 128] int32
+        stage_mv = refs.pop(0)  # VMEM [C*K, 128] int32
+        P_scr = refs.pop(0)  # VMEM [K, 128] int32
+        acc_scr = refs.pop(0)  # VMEM [CARRY_ROWS, 128] int32
+
+    jb = pl.program_id(1)
+    phase1 = jb < n_steps
+    tlen = tlen_ref[0, 0]
+    OFF = off_ref[0, 0]
+    slen = slen_ref[0, 0, :]
+    delta = delta_ref[0, 0, :]
+    nd = ndv_ref[0, 0, :]
+    dend = dend_ref[0, 0, :]
+    d = jax.lax.broadcasted_iota(jnp.int32, (K, LANES), 0)
+    neg = jnp.full((K, LANES), NEG_INF, jnp.float32)
+
+    @pl.when(jb == 0)
+    def _():
+        acc_score[:] = jnp.full((1, LANES), NEG_INF, jnp.float32)
+        if want_stats:
+            P_scr[:] = jnp.zeros((K, LANES), jnp.int32)
+            acc_scr[:] = jnp.zeros((CARRY_ROWS, LANES), jnp.int32)
+
+    @pl.when(phase1)
+    def _():
+        in_band_f = (d >= delta[None, :]) & (d < (delta + nd)[None, :])
+        # mirrored data row of the reversed stream: scratch row m holds
+        # the reversed problem's band row K - 1 - m
+        md = (K - 1) - d
+        in_band_r = (md >= delta[None, :]) & (md < (delta + nd)[None, :])
+
+        prev_f = fcarry[:]
+        prev_r = rcarry[:]
+        for c in range(C):
+            j = jb * C + c
+            first = j == 0
+
+            # ---- forward fill column (fill_pallas._fill_kernel) ------
+            i = d + (j - OFF)
+            valid = (i >= 0) & (i <= slen[None, :]) & in_band_f & (j <= tlen)
+            mw = fmt_ref[0, c : c + K, :]
+            mmw = fmm_ref[0, c : c + K, :]
+            giw = fgi_ref[0, c : c + K, :]
+            dlw = fdl_ref[0, c : c + K, :]
+            sqw = fsq_ref[0, c : c + K, :]
+            tb = t_ref[0, j]
+            msc = jnp.where(sqw == tb, mw, mmw)
+            mcand = jnp.where(
+                (i >= 1) & jnp.logical_not(first), prev_f + msc, neg
+            )
+            prev_up = pltpu.roll(prev_f, K - 1, axis=0)
+            prev_up = jnp.where(d == K - 1, neg, prev_up)
+            dcand = jnp.where(first, neg, prev_up + dlw)
+            cand = jnp.maximum(mcand, dcand)
+            cand = jnp.where(first & (i == 0), 0.0, cand)
+            cand = jnp.where(valid, cand, neg)
+            g = jnp.where((i >= 1) & valid, giw, 0.0)
+            G = _cumop(g, lambda a, b: a + b, K)
+            F = G + _cumop(cand - G, jnp.maximum, K)
+            F = jnp.where(valid, F, neg)
+
+            if want_stats:
+                icand = pltpu.roll(F, 1, axis=0)
+                icand = jnp.where(d == 0, neg, icand) + g
+                mv = jnp.where(
+                    (mcand >= icand) & (mcand >= dcand),
+                    TRACE_MATCH,
+                    jnp.where(icand >= dcand, TRACE_INSERT, TRACE_DELETE),
+                )
+                live = valid & (F > NEG_LIVE)
+                mv = jnp.where(
+                    first,
+                    jnp.where((i > 0) & live, TRACE_INSERT, TRACE_NONE),
+                    jnp.where(live, mv, TRACE_NONE),
+                )
+                stage_mv[c * K : (c + 1) * K, :] = mv.astype(jnp.int32)
+
+            prev_f = F
+            stage_f[c * K : (c + 1) * K, :] = F
+
+            @pl.when(j == tlen)
+            def _():
+                sel = jnp.where(d == dend[None, :], F, NEG_INF)
+                acc_score[:] = jnp.max(sel, axis=0, keepdims=True)
+
+            # ---- mirrored reversed fill column -----------------------
+            # identical math at data row K - 1 - m; the delete
+            # predecessor (data row + 1) sits at scratch row m - 1, and
+            # the within-column insert chain runs as the SUFFIX scan —
+            # same operand pairs, same association tree, so the values
+            # are bit-identical to the oracle's reversed stream
+            ir = md + (j - OFF)
+            validr = (
+                (ir >= 0) & (ir <= slen[None, :]) & in_band_r & (j <= tlen)
+            )
+            o = C - 1 - c  # mirrored window offset within the block
+            rmw = rmt_ref[0, o : o + K, :]
+            rmmw = rmm_ref[0, o : o + K, :]
+            rgiw = rgi_ref[0, o : o + K, :]
+            rdlw = rdl_ref[0, o : o + K, :]
+            rsqw = rsq_ref[0, o : o + K, :]
+            tbr = t_ref[1, j]
+            mscr = jnp.where(rsqw == tbr, rmw, rmmw)
+            mcandr = jnp.where(
+                (ir >= 1) & jnp.logical_not(first), prev_r + mscr, neg
+            )
+            prev_dn = pltpu.roll(prev_r, 1, axis=0)
+            prev_dn = jnp.where(d == 0, neg, prev_dn)
+            dcandr = jnp.where(first, neg, prev_dn + rdlw)
+            candr = jnp.maximum(mcandr, dcandr)
+            candr = jnp.where(first & (ir == 0), 0.0, candr)
+            candr = jnp.where(validr, candr, neg)
+            gr = jnp.where((ir >= 1) & validr, rgiw, 0.0)
+            Gr = _cumop_rev(gr, lambda a, b: a + b, K)
+            Fr = Gr + _cumop_rev(candr - Gr, jnp.maximum, K)
+            Fr = jnp.where(validr, Fr, neg)
+            prev_r = Fr
+            stage_r[c * K : (c + 1) * K, :] = Fr
+
+        fcarry[:] = prev_f
+        rcarry[:] = prev_r
+
+        dma = pltpu.make_async_copy(
+            stage_f, band_f.at[pl.ds(jb * C * K, C * K), :], sem
+        )
+        dma.start()
+        dma.wait()
+        dma = pltpu.make_async_copy(
+            stage_r, band_r.at[pl.ds(jb * C * K, C * K), :], sem
+        )
+        dma.start()
+        dma.wait()
+        if want_stats:
+            dma = pltpu.make_async_copy(
+                stage_mv, moves_any.at[pl.ds(jb * C * K, C * K), :], sem
+            )
+            dma.start()
+            dma.wait()
+
+    @pl.when(jnp.logical_not(phase1))
+    def _():
+        jb2 = (2 * n_steps - 1) - jb
+        Wk = (C + 2) * K
+
+        dma = pltpu.make_async_copy(
+            band_f.at[pl.ds(jb2 * C * K, C * K), :], stage_f, sem
+        )
+        dma.start()
+        dma.wait()
+        # backward window: columns [jb2*C, jb2*C + C] of B live at
+        # mirrored flat rows (tlen - j) * K + (K - 1 - S_l) + d; fetch
+        # (C + 2) column blocks from the clamped base and realign with
+        # one per-lane cyclic roll
+        base_raw = (tlen - jb2 * C - C - 1) * K
+        base = jnp.clip(base_raw, 0, n_steps * C * K - Wk)
+        dma = pltpu.make_async_copy(
+            band_r.at[pl.ds(base, Wk), :], stage_b, sem
+        )
+        dma.start()
+        dma.wait()
+        if want_stats:
+            dma = pltpu.make_async_copy(
+                moves_any.at[pl.ds(jb2 * C * K, C * K), :], stage_mv, sem
+            )
+            dma.start()
+            dma.wait()
+
+        S_l = dend + OFF  # slen - tlen + 2*OFF, per lane
+        s_l = (K - 1) - S_l - (base - base_raw)
+        t_l = jnp.mod(-s_l, Wk)[None, :]  # rolled[r] = win[(r + s_l) % Wk]
+        rolled = stage_b[:]
+        bit = 1
+        while bit < Wk:
+            rcand = pltpu.roll(rolled, bit, axis=0)
+            rolled = jnp.where((t_l & bit) != 0, rcand, rolled)
+            bit *= 2
+
+        roff = roff_ref[0, 0, :]
+        bw = bw_ref[0, 0, :]
+        zero16 = jnp.full((ROWS - 9, LANES), 0.0, jnp.float32)
+        v_off = jnp.maximum(slen - tlen, 0)
+        zero_i = jnp.zeros((1, LANES), jnp.int32)
+
+        if want_stats:
+            P = P_scr[:] > 0
+            nerr = acc_scr[0:1, :]
+            reached = acc_scr[1:2, :]
+
+        # columns DESCEND: the fused stats sweep chains P toward j - 1
+        # (the dense math is column-independent, so it rides along)
+        for c in range(C - 1, -1, -1):
+            j = jb2 * C + c
+
+            # ---- dense all-edits column (dense_pallas._dense_kernel) -
+            A_j = stage_f[c * K : (c + 1) * K, :]
+            B_j = rolled[(C + 1 - c) * K : (C + 2 - c) * K, :]
+            B_n = rolled[(C - c) * K : (C + 1 - c) * K, :]
+
+            A_up = pltpu.roll(A_j, K - 1, axis=0)
+            A_up = jnp.where(d == K - 1, neg, A_up)
+            A_dn = pltpu.roll(A_j, 1, axis=0)
+            A_dn = jnp.where(d == 0, neg, A_dn)
+            B_n_dn = pltpu.roll(B_n, 1, axis=0)
+            B_n_dn = jnp.where(d == 0, neg, B_n_dn)
+
+            jc = jnp.minimum(j + 1, tlen)
+            rmin = jnp.maximum(0, jc - roff)
+            rmax = jnp.minimum(jc + v_off + bw, slen)
+
+            dele = jnp.max(A_j + B_n_dn, axis=0, keepdims=True)
+
+            def edit_scores(i, sq, mt, mm, gi, dl, m_src, d_src, B_join):
+                valid = (i >= rmin[None, :]) & (i <= rmax[None, :])
+                dcand = d_src + dl
+                g = jnp.where((i >= 1) & valid, gi, 0.0)
+                G = _cumop(g, lambda a, b: a + b, K)
+                outs = []
+                for b in range(4):
+                    msc = jnp.where(sq == b, mt, mm)
+                    mcand = jnp.where(i >= 1, m_src + msc, neg)
+                    cand = jnp.where(valid, jnp.maximum(mcand, dcand), neg)
+                    NC = G + _cumop(cand - G, jnp.maximum, K)
+                    NC = jnp.where(valid, NC, neg)
+                    outs.append(jnp.max(NC + B_join, axis=0, keepdims=True))
+                return outs
+
+            subs = edit_scores(
+                d + (j + 1 - OFF),
+                fsq_ref[0, c + 1 : c + 1 + K, :],
+                fmt_ref[0, c + 1 : c + 1 + K, :],
+                fmm_ref[0, c + 1 : c + 1 + K, :],
+                fgi_ref[0, c + 1 : c + 1 + K, :],
+                fdl_ref[0, c + 1 : c + 1 + K, :],
+                A_j, A_up, B_n,
+            )
+            insr = edit_scores(
+                d + (j - OFF),
+                fsq_ref[0, c : c + K, :],
+                fmt_ref[0, c : c + K, :],
+                fmm_ref[0, c : c + K, :],
+                fgi_ref[0, c : c + K, :],
+                fdl_ref[0, c : c + K, :],
+                A_dn, A_j, B_j,
+            )
+            dense_ref[0, 0, c * ROWS : (c + 1) * ROWS, :] = jnp.concatenate(
+                [dele] + subs + insr + [zero16], axis=0
+            )
+
+            # ---- fused reverse stats column (stats_pallas) -----------
+            if want_stats:
+                mv = stage_mv[c * K : (c + 1) * K, :].astype(jnp.int32)
+                sb = fsq_ref[0, c : c + K, :]
+                tb = t_ref[0, j]
+
+                seed = P | ((j == tlen) & (d == dend[None, :]))
+                ichain = mv == TRACE_INSERT
+
+                ich_up = pltpu.roll(ichain.astype(jnp.float32), K - 1,
+                                    axis=0)
+                ich_up = jnp.where(d == K - 1, 0.0, ich_up)
+                gs = jnp.where(ich_up > 0, 0.0, -1e6)
+                cands = jnp.where(seed, 0.0, -1e12)
+                Gs = _cumop_rev(gs, lambda a, b: a + b, K)
+                Fs = Gs + _cumop_rev(cands - Gs, jnp.maximum, K)
+                on = Fs > -1e5
+
+                is_m = on & (mv == TRACE_MATCH)
+                is_i = on & ichain
+                is_d = on & (mv == TRACE_DELETE)
+                mism = is_m & (sb != tb)
+                err = mism | is_i | is_d
+                nerr = nerr + jnp.sum(err.astype(jnp.int32), axis=0,
+                                      keepdims=True, dtype=jnp.int32)
+                r0 = jnp.sum(
+                    (on & (d == OFF)).astype(jnp.int32), axis=0,
+                    keepdims=True, dtype=jnp.int32,
+                )
+                reached = reached | jnp.where(j == 0, r0, zero_i)
+
+                def any_row(m):
+                    return jnp.max(m.astype(jnp.float32), axis=0,
+                                   keepdims=True)
+
+                rows = (
+                    [any_row(mism & (sb == b)) for b in range(4)]
+                    + [any_row(is_i & (sb == b)) for b in range(4)]
+                    + [any_row(is_d),
+                       jnp.zeros((ROWS - 9, LANES), jnp.float32)]
+                )
+                tiles_ref[c * ROWS : (c + 1) * ROWS, :] = jnp.concatenate(
+                    rows, axis=0
+                )
+
+                is_d_dn = pltpu.roll(is_d.astype(jnp.float32), 1, axis=0)
+                is_d_dn = jnp.where(d == 0, 0.0, is_d_dn)
+                P = is_m | (is_d_dn > 0)
+
+        if want_stats:
+            P_scr[:] = P.astype(jnp.int32)
+            acc_new = jnp.concatenate(
+                [nerr, reached,
+                 jnp.zeros((CARRY_ROWS - 2, LANES), jnp.int32)],
+                axis=0,
+            )
+            acc_scr[:] = acc_new
+
+            @pl.when(jb == 2 * n_steps - 1)
+            def _():
+                acc_ref[:] = acc_new
+
+    @pl.when(jb == 2 * n_steps - 1)
+    def _():
+        score_ref[:] = acc_score[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("K", "T1p", "C", "want_stats", "interpret"),
+)
+def _mega_call(
+    tlen_s,  # [1, 1] int32
+    off_s,  # [1, 1] int32
+    t_cols,  # [2, T1p] int32
+    meta6,  # [6, 1, Npad] int32: slen, delta, nd, dend, roff, bw
+    fwd_tabs,  # 5 x [n_steps, CB, Npad]
+    rev_tabs,  # 5 x [n_steps, CB, Npad] mirrored
+    K: int,
+    T1p: int,
+    C: int,
+    want_stats: bool = False,
+    interpret: bool = False,
+):
+    n_steps = T1p // C
+    Npad = meta6.shape[2]
+    NB = Npad // LANES
+    CB = C + K
+    grid = (NB, 2 * n_steps)
+
+    def smem_spec():
+        return pl.BlockSpec(
+            (1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM
+        )
+
+    def lane_spec():
+        return pl.BlockSpec(
+            (1, 1, LANES), lambda nb, jb: (0, 0, nb),
+            memory_space=pltpu.VMEM,
+        )
+
+    def fwd_tab_spec():
+        # phase 1 streams block jb (the fill), phase 2 re-reads block
+        # jb2 (the dense windows + the stats read-base table)
+        return pl.BlockSpec(
+            (1, CB, LANES),
+            lambda nb, jb, n=n_steps: (
+                jnp.where(jb < n, jb, 2 * n - 1 - jb), 0, nb
+            ),
+            memory_space=pltpu.VMEM,
+        )
+
+    def rev_tab_spec():
+        # phase-1 only; parked on the last fill block through phase 2
+        return pl.BlockSpec(
+            (1, CB, LANES),
+            lambda nb, jb, n=n_steps: (
+                jnp.where(jb < n, jb, n - 1), 0, nb
+            ),
+            memory_space=pltpu.VMEM,
+        )
+
+    in_specs = (
+        [smem_spec(), smem_spec(),
+         pl.BlockSpec((2, T1p), lambda nb, jb: (0, 0),
+                      memory_space=pltpu.SMEM)]
+        + [lane_spec() for _ in range(6)]
+        + [fwd_tab_spec() for _ in range(5)]
+        + [rev_tab_spec() for _ in range(5)]
+    )
+
+    # phase-1 steps park the write-once outputs on the block phase 2
+    # touches first (jb2 = n_steps - 1): the parked garbage is
+    # overwritten in place before any block switch flushes it
+    out_specs = [
+        pl.BlockSpec(
+            (1, 1, C * ROWS, LANES),
+            lambda nb, jb, n=n_steps: (
+                nb, jnp.where(jb < n, n - 1, 2 * n - 1 - jb), 0, 0
+            ),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, LANES), lambda nb, jb: (0, nb), memory_space=pltpu.VMEM
+        ),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((NB, n_steps, C * ROWS, LANES), jnp.float32),
+        jax.ShapeDtypeStruct((1, NB * LANES), jnp.float32),
+    ]
+    if want_stats:
+        out_specs.append(
+            pl.BlockSpec(
+                (C * ROWS, LANES),
+                lambda nb, jb, n=n_steps: (
+                    jnp.where(jb < n, n - 1, 2 * n - 1 - jb), nb
+                ),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((n_steps * C * ROWS, NB * LANES),
+                                 jnp.float32)
+        )
+        out_specs.append(
+            pl.BlockSpec(
+                (CARRY_ROWS, LANES), lambda nb, jb: (0, nb),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((CARRY_ROWS, NB * LANES), jnp.int32)
+        )
+
+    scratch = [
+        pltpu.ANY((T1p * K, LANES), jnp.float32),  # band_f
+        pltpu.ANY((T1p * K, LANES), jnp.float32),  # band_r (mirrored)
+        pltpu.VMEM((C * K, LANES), jnp.float32),  # stage_f
+        pltpu.VMEM((C * K, LANES), jnp.float32),  # stage_r
+        pltpu.VMEM(((C + 2) * K, LANES), jnp.float32),  # stage_b
+        pltpu.SemaphoreType.DMA,
+        pltpu.VMEM((K, LANES), jnp.float32),  # fcarry
+        pltpu.VMEM((K, LANES), jnp.float32),  # rcarry
+        pltpu.VMEM((1, LANES), jnp.float32),  # acc_score
+    ]
+    if want_stats:
+        scratch += [
+            pltpu.ANY((T1p * K, LANES), jnp.int32),  # moves
+            pltpu.VMEM((C * K, LANES), jnp.int32),  # stage_mv
+            pltpu.VMEM((K, LANES), jnp.int32),  # P_scr
+            pltpu.VMEM((CARRY_ROWS, LANES), jnp.int32),  # acc_scr
+        ]
+
+    mt, mm, gi, dl, sq = fwd_tabs
+    rmt, rmm, rgi, rdl, rsq = rev_tabs
+    return pl.pallas_call(
+        functools.partial(
+            _mega_kernel, K=K, C=C, n_steps=n_steps,
+            want_stats=want_stats,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        compiler_params=_CompilerParams(
+            # lane blocks share the scratch carry: both axes sequential
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        tlen_s, off_s, t_cols,
+        meta6[0][None], meta6[1][None], meta6[2][None],
+        meta6[3][None], meta6[4][None], meta6[5][None],
+        mt, mm, gi, dl, sq, rmt, rmm, rgi, rdl, rsq,
+    )
+
+
+def fused_tables_mega(
+    template,  # int8 [Tmax]
+    tlen,  # int32
+    bufs: FillBuffers,
+    geom: BandGeometry,
+    weights,  # [N] f32
+    K: int,
+    T1p: int,
+    C: int,
+    want_stats: bool = False,
+    off_override=None,
+    interpret: bool = False,
+):
+    """One fused consensus step in a SINGLE Pallas launch — same dict
+    contract as dense_pallas.fused_tables_pallas (minus want_moves,
+    which declines to the split path in fused_tables_auto)."""
+    Npad = bufs.seq_T.shape[1]
+    NB = Npad // LANES
+    n_steps = T1p // C
+    prep = prepare_fused(template, tlen, bufs, geom, K, T1p, C,
+                         off_override=off_override)
+    outs = _mega_call(
+        prep["tlen_s"], prep["off_s"], prep["t_cols"], prep["meta6"],
+        prep["fwd_tabs"], prep["rev_tabs"],
+        K=K, T1p=T1p, C=C, want_stats=want_stats, interpret=interpret,
+    )
+    outs = list(outs)
+    dense_out = outs.pop(0)
+    scores2 = outs.pop(0)
+
+    # identical epilogue to dense_call + dense_tables_pallas /
+    # fused_tables_pallas: same reshape, same masked weighted reduction
+    per_lane = dense_out.reshape(NB, n_steps, C, ROWS, LANES)
+    per_lane = per_lane.transpose(1, 2, 3, 0, 4).reshape(
+        T1p, ROWS, NB * LANES
+    )
+    w = _pad_lanes(weights.astype(jnp.float32), Npad)
+    ww = w[None, None, :]
+    tables = jnp.sum(jnp.where(ww > 0, per_lane, 0.0) * ww, axis=2)
+    scores = scores2[0, :Npad]
+    total = jnp.sum(jnp.where(w > 0, scores, 0.0) * w)
+    out = {
+        "total": total, "scores": scores,
+        "sub": tables[:, 1:5], "ins": tables[:, 5:9], "del": tables[:, 0],
+    }
+    if want_stats:
+        tiles = outs.pop(0)
+        acc = outs.pop(0)
+        T1 = template.shape[0] + 1
+        out["n_errors"] = _finish_nerr(acc, Npad)
+        um = jnp.max(tiles.reshape(T1p, ROWS, NB * LANES), axis=2)[:T1]
+        out["edits"] = _edits_from_union(um > 0.0)
+    return out
+
+
+def fused_tables_auto(
+    template,
+    tlen,
+    bufs: FillBuffers,
+    geom: BandGeometry,
+    weights,
+    K: int,
+    T1p: int,
+    C: int,
+    want_stats: bool = False,
+    want_moves: bool = False,
+    off_override=None,
+    slen_min=None,
+    interpret: bool = False,
+    impl=None,
+    vmem_budget=None,
+):
+    """Route one fused step to the megakernel or the 3-launch split
+    oracle (same dict contract either way, plus out["impl"] naming the
+    path taken). ``impl`` overrides the env selector (pass the value
+    resolved at dispatch-planning time so a jit trace cache keyed on it
+    stays honest); ``vmem_budget`` overrides the planner budget (the
+    decline guard test shrinks it)."""
+    sel, _ = select_impl(T1p, K, want_stats=want_stats,
+                         want_moves=want_moves, vmem_budget=vmem_budget,
+                         impl=impl)
+    if sel == "mega":
+        Cm = mega_cols(T1p, K, want_stats=want_stats, interpret=interpret,
+                       vmem_budget=vmem_budget)
+        out = fused_tables_mega(
+            template, tlen, bufs, geom, weights, K, T1p, Cm,
+            want_stats=want_stats, off_override=off_override,
+            interpret=interpret,
+        )
+    else:
+        out = fused_tables_pallas(
+            template, tlen, bufs, geom, weights, K, T1p, C,
+            want_stats=want_stats, want_moves=want_moves,
+            off_override=off_override, slen_min=slen_min,
+            interpret=interpret,
+        )
+    out["impl"] = sel
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("K", "T1p", "C", "want_stats", "interpret"),
+)
+def _fused_step_mega(
+    template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
+    K: int, T1p: int, C: int,
+    want_stats: bool = False, interpret: bool = False,
+):
+    out = fused_tables_mega(
+        template, tlen, bufs, geom, weights, K, T1p, C,
+        want_stats=want_stats, interpret=interpret,
+    )
+    return jnp.concatenate(pack_parts(out, want_stats))
+
+
+def fused_step_auto(
+    template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
+    K: int, T1p: int, C: int,
+    want_stats: bool = False, want_moves: bool = False,
+    interpret: bool = False, impl=None,
+):
+    """Packed-single-fetch dispatcher (dense_pallas.fused_step_pallas's
+    contract: (packed, moves-or-None)) routing to the megakernel when
+    eligible. The impl decision happens OUTSIDE the jitted bodies, so
+    flipping RIFRAF_TPU_FUSED_IMPL between calls takes effect without
+    poisoning a trace cache."""
+    from .dense_pallas import fused_step_pallas
+
+    sel, _ = select_impl(T1p, K, want_stats=want_stats,
+                         want_moves=want_moves, impl=impl)
+    if sel == "mega":
+        Cm = mega_cols(T1p, K, want_stats=want_stats, interpret=interpret)
+        packed = _fused_step_mega(
+            template, tlen, bufs, geom, weights, K, T1p, Cm,
+            want_stats=want_stats, interpret=interpret,
+        )
+        return packed, None
+    return fused_step_pallas(
+        template, tlen, bufs, geom, weights, K, T1p, C,
+        want_stats=want_stats, want_moves=want_moves, interpret=interpret,
+    )

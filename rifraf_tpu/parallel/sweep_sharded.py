@@ -21,7 +21,16 @@ lru-cached program factories, the pattern of engine.realign's
 across calls. Real read sets are heterogeneous (amplicon sweeps mix
 200 bp and 3 kb clusters); padding everything to the global maxima
 burns device cells on padding — the per-bucket padded/useful cell
-accounting comes back in ``SweepStats``. ``scheduler="uniform"`` keeps
+accounting comes back in ``SweepStats``. Chunks are additionally sized
+to FILL the 128-lane vector axis (``lane_target``): a bucket of small
+clusters packs ceil(128/Npad) of them per launch instead of letting the
+hardware pad a quarter-full lane tile, buckets too small to ever fill a
+tile are coalesced into coarser-grid neighbours first
+(``_coalesce_underfilled`` — padding is masked, so only the reported
+``waste`` moves), and the executed lane fill is reported per bucket
+(``BucketStats.lane_slot_occupancy``) and in aggregate
+(``SweepStats.lane_occupancy`` / ``lane_occupancy_reads``).
+``scheduler="uniform"`` keeps
 the legacy everything-to-global-maxima layout (one bucket, band grid 8,
 raw read-count padding), with chunk shapes pinned to the GLOBAL grid so
 chunked calls no longer recompile per chunk.
@@ -58,6 +67,7 @@ import numpy as np
 
 from ..models.sequences import ReadScores, batch_reads
 from ..utils.mathops import logsumexp10, poisson_cquantile
+from ..utils.shapes import LANES
 from ..utils.shapes import bucket as _bucket
 from .cluster import pipeline_map
 
@@ -66,6 +76,18 @@ MAX_BANDWIDTH_DOUBLINGS = 5  # model.jl:650
 # bucketed-scheduler grid defaults: read-count and band-height rounding
 READ_BUCKET = 8
 BAND_BUCKET = 16
+# lane-packing floor: a chunk's read-lane footprint (gp clusters x Npad
+# reads) is padded by the hardware to 128-lane multiples, so chunks are
+# sized to fill at least one full lane tile when the bucket has the
+# members (plan_sweep lane_target)
+LANE_TARGET = LANES
+
+
+def _lane_slots(gp: int, n: int, lanes: int = LANES) -> int:
+    """Hardware lane slots one chunk's launch occupies: the [gp, Npad]
+    read axes flatten onto the 128-lane vector axis, padded up to a lane
+    multiple."""
+    return -(-gp * n // lanes) * lanes
 
 
 class SweepResult(NamedTuple):
@@ -94,6 +116,11 @@ class BucketStats(NamedTuple):
     # a lane-packed Pallas engine would actually use
     lane_occupancy: float = 1.0
     uniform_lane_occupancy: float = 1.0
+    # EXECUTED lane packing (the plan_sweep lane_target floor): hardware
+    # 128-lane slots the bucket's launches occupied, and the fraction of
+    # them that carried a real read (real reads / lane_slots)
+    lane_slots: int = 0
+    lane_slot_occupancy: float = 1.0
 
 
 class SweepStats(NamedTuple):
@@ -108,6 +135,14 @@ class SweepStats(NamedTuple):
     uniform_padded_cells: int
     seconds: float  # wall time of the whole sweep
     buckets: List[BucketStats]
+    # aggregate executed lane fill, two levels: ``lane_occupancy`` is
+    # the fraction of occupied 128-lane slots carrying a real CLUSTER's
+    # Npad block (what chunk sizing + bucket coalescing control — the
+    # rest is tile-rounding/pad-cluster loss); ``lane_occupancy_reads``
+    # further discounts within-cluster read padding (n_reads < Npad),
+    # which is bounded by the read-count bucket grid, not by packing
+    lane_occupancy: float = 1.0
+    lane_occupancy_reads: float = 1.0
 
 
 class BucketPlan(NamedTuple):
@@ -176,6 +211,70 @@ def bucket_key(
     )
 
 
+def _coalesce_underfilled(
+    groups: dict,
+    infos: List["_ClusterInfo"],
+    read_bucket: int,
+    band_bucket: int,
+    len_bucket: int,
+    lane_target: int,
+) -> dict:
+    """Merge buckets too small to fill one lane tile into coarser-grid
+    neighbours. A bucket whose whole membership occupies fewer than
+    ``lane_target`` read lanes (``Npad * members``) cannot fill a single
+    128-lane tile no matter how it is chunked, so its launch pays a
+    mostly-empty tile AND its signature pays a compile. Regrouping those
+    members with the SHAPE axes (Lpad, Tmax, K0) rounded on a 2x/4x/8x
+    coarser grid coalesces near-miss shapes into shared, fuller
+    launches. The read-count axis keeps its fine grid: coarsening Npad
+    would pad every cluster's read lanes, which is exactly the waste
+    lane packing exists to avoid. Correctness is the module invariant —
+    a key is only a padding spec, any key that covers a member's demands
+    yields bit-identical results (band-height padding is masked by the
+    band geometry, weight-0 pad reads/clusters drop out of reductions) —
+    so coalescing trades padded cells (reported as ``waste``) for lane
+    fill and fewer compiled signatures."""
+    for scale in (2, 4, 8):
+        small = [
+            k for k, members in groups.items()
+            if k[0] * len(members) < lane_target
+        ]
+        if len(small) <= 1:
+            break
+        for k in small:
+            members = groups.pop(k)
+            for i in members:
+                ck = bucket_key(
+                    infos[i], read_bucket, band_bucket * scale,
+                    len_bucket * scale,
+                )
+                groups.setdefault(ck, []).append(i)
+    # absorb the ragtag tail: whatever is still under one tile after the
+    # coarsest regroup merges per read-count class into ONE bucket at
+    # the elementwise-max key (the uniform layout, but scoped to the
+    # handful of stragglers instead of the whole sweep)
+    small = [
+        k for k, members in groups.items()
+        if k[0] * len(members) < lane_target
+    ]
+    by_npad = {}
+    for k in small:
+        by_npad.setdefault(k[0], []).append(k)
+    for npad, keys in by_npad.items():
+        if len(keys) <= 1:
+            continue
+        members = []
+        for k in keys:
+            members.extend(groups.pop(k))
+        mk = tuple(max(k[d] for k in keys) for d in range(4))
+        groups.setdefault(mk, []).extend(members)
+    # merging interleaves members — restore input order per bucket (the
+    # planner's documented intra-bucket order invariant)
+    for members in groups.values():
+        members.sort()
+    return groups
+
+
 def plan_sweep(
     clusters: Sequence[Sequence[ReadScores]],
     scheduler: str = "bucketed",
@@ -185,6 +284,7 @@ def plan_sweep(
     cluster_chunk: int = 0,
     n_axis: int = 1,
     infos: Optional[List[_ClusterInfo]] = None,
+    lane_target: int = LANE_TARGET,
 ) -> List[BucketPlan]:
     """Group clusters into shape buckets and chunk each bucket's cluster
     axis. Pure host arithmetic — no JAX — so planner invariants are
@@ -197,6 +297,19 @@ def plan_sweep(
     Either way every chunk of a bucket is padded to the same ``gp``
     (``cluster_chunk`` rounded up to the cluster grid), so chunked calls
     reuse one executable instead of recompiling per chunk.
+
+    ``lane_target`` makes lane packing an EXECUTION strategy, not just
+    an accounting stat: a bucketed chunk's launch flattens [gp, Npad]
+    read axes onto the 128-lane vector axis, so a small-cluster bucket
+    (say Npad=8) chunked at gp=4 fills a quarter of one lane tile and
+    the hardware pads the rest. The floor raises each bucket's chunk
+    target until gp*Npad >= lane_target (bounded by the bucket's member
+    count), packing multiple small clusters into full lane tiles — it
+    takes precedence over a smaller ``cluster_chunk`` because the
+    per-launch footprint of such a bucket is tiny anyway. Buckets whose
+    WHOLE membership cannot fill one tile are first coalesced into
+    coarser-grid neighbours (see _coalesce_underfilled). 0 disables
+    both.
     """
     if scheduler not in ("bucketed", "uniform"):
         raise ValueError(f"unknown sweep scheduler: {scheduler!r}")
@@ -226,12 +339,19 @@ def plan_sweep(
         for i, info in enumerate(infos):
             key = bucket_key(info, read_bucket, band, len_bucket)
             groups.setdefault(key, []).append(i)
+        if lane_target > 0:
+            groups = _coalesce_underfilled(
+                groups, infos, read_bucket, band, len_bucket, lane_target
+            )
 
     plans = []
     for key, members in groups.items():
         target = min(len(members), cluster_chunk) if cluster_chunk else (
             len(members)
         )
+        if scheduler == "bucketed" and lane_target > 0:
+            want = -(-lane_target // key[0])  # clusters per full lane tile
+            target = max(target, min(len(members), want))
         gp = _bucket(max(target, 1), grid)
         chunks = [members[s : s + gp] for s in range(0, len(members), gp)]
         plans.append(BucketPlan(key=key, band=band, gp=gp, chunks=chunks))
@@ -519,6 +639,7 @@ def sweep_clusters_sharded(
     band_bucket: int = BAND_BUCKET,
     do_alignment_proposals: bool = False,
     return_stats: bool = False,
+    lane_target: int = LANE_TARGET,
 ):
     """One consensus per cluster, all clusters in one device program.
 
@@ -530,7 +651,9 @@ def sweep_clusters_sharded(
     in HBM simultaneously — a 1024-cluster batch can exceed one chip);
     the effective chunk size rounds up to the cluster grid so all
     chunks share one shape. ``scheduler``/``read_bucket``/
-    ``band_bucket``: see plan_sweep. ``do_alignment_proposals`` enables
+    ``band_bucket``/``lane_target``: see plan_sweep (``lane_target``
+    packs multiple small clusters into full 128-lane tiles per launch).
+    ``do_alignment_proposals`` enables
     the in-kernel alignment-edits candidate gate (the driver default),
     matching ``rifraf(..., do_alignment_proposals=True)``.
 
@@ -546,6 +669,7 @@ def sweep_clusters_sharded(
         clusters, scheduler=scheduler, read_bucket=read_bucket,
         band_bucket=band_bucket, len_bucket=len_bucket,
         cluster_chunk=cluster_chunk, n_axis=n_axis, infos=infos,
+        lane_target=lane_target,
     )
     if G == 0:
         stats = SweepStats(0, 0, 0, 0, 0, 0.0, 0, 0.0, [])
@@ -593,6 +717,9 @@ def sweep_clusters_sharded(
 
     useful_total = sum(i.useful for i in infos)
     buckets = []
+    reads_used = 0
+    cluster_lanes = 0
+    slots_total = 0
     for bi, plan in enumerate(plans):
         n_in = sum(len(ch) for ch in plan.chunks)
         padded = len(plan.chunks) * plan.gp * plan.key[0] * plan.key[1]
@@ -602,6 +729,13 @@ def sweep_clusters_sharded(
             for r in clusters[ci]
         ]
         pk = pack_lanes(lane_lens)
+        slots = len(plan.chunks) * _lane_slots(plan.gp, plan.key[0])
+        reads = sum(
+            infos[ci].n_reads for ch in plan.chunks for ci in ch
+        )
+        reads_used += reads
+        cluster_lanes += n_in * plan.key[0]
+        slots_total += slots
         buckets.append(BucketStats(
             key=plan.key, n_clusters=n_in, n_chunks=len(plan.chunks),
             gp=plan.gp,
@@ -611,6 +745,8 @@ def sweep_clusters_sharded(
             seconds=bucket_seconds[bi],
             lane_occupancy=pk.occupancy,
             uniform_lane_occupancy=pk.uniform_occupancy,
+            lane_slots=slots,
+            lane_slot_occupancy=reads / slots if slots else 1.0,
         ))
     padded_total = plan_cells(plans)
     uniform_plans = plan_sweep(
@@ -624,5 +760,9 @@ def sweep_clusters_sharded(
         uniform_padded_cells=plan_cells(uniform_plans),
         seconds=time.perf_counter() - t_start,
         buckets=buckets,
+        lane_occupancy=cluster_lanes / slots_total if slots_total else 1.0,
+        lane_occupancy_reads=(
+            reads_used / slots_total if slots_total else 1.0
+        ),
     )
     return list(out), stats

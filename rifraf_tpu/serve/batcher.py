@@ -6,6 +6,9 @@ no JAX. Requests are grouped by their sweep-scheduler shape key
 (``parallel.sweep_sharded.bucket_key``); a bucket flushes when
 
 - it reaches ``max_batch`` requests (occupancy flush),
+- its pending requests fill the 128-lane vector axis,
+  ``pending * Npad >= lane_target`` (lane-capacity flush — the launch's
+  read lanes are full, so waiting longer only adds lane tiles),
 - its OLDEST request has waited ``max_wait_ms`` (latency flush), or
 - any member's deadline is within ``deadline_margin_ms`` (deadline-risk
   flush — dispatch now or miss it).
@@ -35,10 +38,15 @@ class MicroBatcher:
 
     def add(self, req: Request) -> Optional[List[Request]]:
         """Admit one request; returns a full bucket's flush (in arrival
-        order) when this request filled it, else None."""
+        order) when this request filled it — by request count
+        (``max_batch``) or by lane capacity (``lane_target`` read lanes,
+        ``req.key[0]`` = Npad reads per cluster) — else None."""
         bucket = self._pending.setdefault(req.key, [])
         bucket.append(req)
-        if len(bucket) >= self.config.max_batch:
+        lane_target = self.config.lane_target
+        if len(bucket) >= self.config.max_batch or (
+            lane_target > 0 and len(bucket) * req.key[0] >= lane_target
+        ):
             return self._pending.pop(req.key)
         return None
 

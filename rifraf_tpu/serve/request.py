@@ -40,6 +40,11 @@ class ServeConfig:
     # flush a bucket as soon as it holds this many requests (also the
     # cluster-axis padding ceiling of a micro-batch)
     max_batch: int = 16
+    # ... or as soon as its pending requests fill the 128-lane vector
+    # axis (pending * Npad >= lane_target): a big-cluster bucket (say
+    # Npad=64) dispatches at 2 requests instead of waiting out
+    # max_wait_ms for 14 more that would only add lane tiles. 0 disables
+    lane_target: int = 128
     # ... or when its oldest request has waited this long
     max_wait_ms: float = 20.0
     # ... or when any member's deadline is within this margin (the time

@@ -35,6 +35,10 @@ class ServerStats:
         self._padded_slots = 0
         self._useful_cells = 0
         self._padded_cells = 0
+        self._useful_lanes = 0
+        self._cluster_lanes = 0
+        self._lane_slots = 0
+        self._model_bytes = 0.0
         self._declines: Dict[str, int] = {}
 
     def count(self, name: str, k: int = 1) -> None:
@@ -46,15 +50,29 @@ class ServerStats:
             self._latencies.append(seconds)
 
     def note_batch(self, n_real: int, gp: int, useful_cells: int,
-                   padded_cells: int) -> None:
+                   padded_cells: int, useful_lanes: int = 0,
+                   lane_slots: int = 0, cluster_lanes: int = 0) -> None:
         """One dispatched micro-batch: ``n_real`` live requests padded
-        to a ``gp``-cluster chunk of ``padded_cells`` read-lane cells."""
+        to a ``gp``-cluster chunk of ``padded_cells`` read-lane cells
+        occupying ``lane_slots`` hardware 128-lane slots, of which
+        ``cluster_lanes`` belong to a real request's Npad block and
+        ``useful_lanes`` carried a real read."""
         with self._lock:
             self._batches += 1
             self._batched_requests += n_real
             self._padded_slots += gp
             self._useful_cells += useful_cells
             self._padded_cells += padded_cells
+            self._useful_lanes += useful_lanes
+            self._lane_slots += lane_slots
+            self._cluster_lanes += cluster_lanes
+
+    def note_model_bytes(self, nbytes: float) -> None:
+        """Fold one micro-batch's modelled HBM traffic (utils.roofline
+        fused-step byte model x stage steps) into the running total the
+        bench's pct_hbm_roof is computed from."""
+        with self._lock:
+            self._model_bytes += nbytes
 
     def note_declines(self, declines) -> None:
         """Fold a fallback run's RifrafResult.metadata["declines"] into
@@ -92,6 +110,17 @@ class ServerStats:
                 "padding_waste": round(
                     1.0 - self._useful_cells / self._padded_cells, 4
                 ) if self._padded_cells else None,
+                # slot fill (real requests' Npad blocks over hardware
+                # 128-lane slots — what the lane-capacity flush
+                # controls) and the read-level fill that further
+                # discounts within-request padding to Npad
+                "lane_occupancy": round(
+                    self._cluster_lanes / self._lane_slots, 4
+                ) if self._lane_slots else None,
+                "lane_occupancy_reads": round(
+                    self._useful_lanes / self._lane_slots, 4
+                ) if self._lane_slots else None,
+                "model_gb": round(self._model_bytes / 1e9, 3),
                 "latency_ms": self._percentiles(),
                 "declines": dict(self._declines),
                 "timers": self.timers.to_dict(),

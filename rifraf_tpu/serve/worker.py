@@ -25,7 +25,12 @@ from queue import Empty, Queue
 from typing import List, NamedTuple
 
 from ..parallel.cluster import PipelineJobError, pipeline_map
-from ..parallel.sweep_sharded import BucketPlan, ChunkExecutor, SweepResult
+from ..parallel.sweep_sharded import (
+    BucketPlan,
+    ChunkExecutor,
+    SweepResult,
+    _lane_slots,
+)
 from ..utils.shapes import bucket as _bucket
 from ..utils.shapes import pow2_bucket
 from .errors import DeadlineExceededError, ServeError
@@ -33,6 +38,23 @@ from .request import Request, Response, ServeConfig
 from .stats import ServerStats
 
 STOP = object()  # flush-queue shutdown sentinel
+
+
+def _batch_model_bytes(plan: BucketPlan, results: List[SweepResult]):
+    """Modelled HBM traffic of one fetched micro-batch: the fused-step
+    byte model at the batch's padded shape (lane-slot Npad — the
+    [gp, N] read axes on 128-lane tiles) times its stage-step count
+    (max member iterations; the vmapped while_loop runs until the last
+    cluster converges). Adaptation rounds excluded — a floor."""
+    from ..utils import roofline
+    from ..utils.shapes import plan_cols
+
+    N, _, Tmax, K0 = plan.key
+    C = plan_cols(Tmax, K0, kernel="dense").cols
+    steps = max((r.n_iters for r in results), default=0)
+    return roofline.fused_model(
+        Tmax, K0, _lane_slots(plan.gp, N), C
+    )["bytes"] * steps
 
 
 class Flush(NamedTuple):
@@ -120,6 +142,9 @@ class Worker:
             n_real=len(flush.requests), gp=plan.gp,
             useful_cells=sum(r.info.useful for r in flush.requests),
             padded_cells=plan.gp * N * L,
+            useful_lanes=sum(r.info.n_reads for r in flush.requests),
+            lane_slots=_lane_slots(plan.gp, N),
+            cluster_lanes=len(flush.requests) * N,
         )
         return flush, handle
 
@@ -132,6 +157,7 @@ class Worker:
             return 1
         with self.stats.timers.time("serve_fetch"):
             results = self.executor.collect(handle)
+        self.stats.note_model_bytes(_batch_model_bytes(handle[1], results))
         for req, res in zip(flush.requests, results):
             self._respond_ok(req, res, "batched")
         return len(flush.requests)

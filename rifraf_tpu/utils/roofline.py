@@ -64,19 +64,22 @@ def fill_model(
 
 
 def dense_model(T1p: int, K: int, Npad: int, C: int) -> Dict[str, float]:
-    """HBM bytes + VPU ops for the dense candidate-tables kernel: reads
-    the forward half of the band, the halo-blocked backward band
-    (written by the halo program then read), the 5 forward tables
-    again; writes the [T1p, 16, Npad] per-column join maxima."""
+    """HBM bytes + VPU ops for the dense candidate-tables kernel plus
+    the backward-alignment halo program that feeds it: the halo program
+    reads the raw reversed band once and writes the halo-blocked copy;
+    the kernel reads the forward half of the band, the halo-blocked
+    backward band, and the 5 forward tables again, and writes the
+    [T1p, 16, Npad] per-column join maxima."""
     n_steps = T1p // C
     CB = C + K
     bh = n_steps * (C + 1) * K * Npad * _F32
+    halo_src = K * T1p * Npad * _F32  # raw Brev read by the halo program
     rd = K * T1p * Npad * _F32 + bh + 5 * n_steps * CB * Npad * _F32
     out = T1p * 16 * Npad * _F32
     # per column per base: 2 scans + joins over K rows, 9 outputs
     ops = T1p * Npad * K * (8 * (4 + 2 * math.log2(K)) + 10)
-    return {"bytes": float(rd + out + bh), "ops": float(ops),
-            "halo_bytes": float(bh)}
+    return {"bytes": float(rd + out + bh + halo_src), "ops": float(ops),
+            "halo_bytes": float(bh), "halo_src_bytes": float(halo_src)}
 
 
 def stats_model(
@@ -122,6 +125,51 @@ def fused_model(
         ops += s["ops"]
         parts["stats"] = s
     return {"bytes": float(total), "ops": float(ops), "parts": parts}
+
+
+def fused_mega_model(
+    T1p: int,
+    K: int,
+    Npad: int,
+    C: int,
+    want_stats: bool = False,
+    spread: int = 0,
+) -> Dict[str, float]:
+    """One SINGLE-LAUNCH fused step (ops.fused_pallas megakernel): the
+    band bytes are counted ONCE per direction — each stream's band is
+    written to the chained scratch in phase 1 and read back in phase 2 —
+    instead of the split path's write + halo-copy (write AND read) +
+    re-read. The move codes likewise stay in scratch: one int32 write,
+    one read, no int8 round trip. ``spread`` widens the phase-2 backward
+    window for lane-packed launches (per-problem template lengths make
+    the window (C + 2 + spread) columns instead of (C + 2))."""
+    n_steps = T1p // C
+    CB = C + K
+    # phase 1: both streams' tables read once; both bands written once;
+    # the move band written once (int32) when the stats chain is on
+    tab = 2 * 5 * n_steps * CB * Npad * _F32
+    band_w = 2 * K * T1p * Npad * _F32
+    moves = K * T1p * Npad * _F32 if want_stats else 0.0
+    # phase 2: A read back once; B read back through the rolled window
+    # ((C + 2 + spread) columns per C output columns); forward tables
+    # re-read; dense tiles out; moves read back + stats tiles out
+    a_r = K * T1p * Npad * _F32
+    b_r = n_steps * (C + 2 + spread) * K * Npad * _F32
+    tab2 = 5 * n_steps * CB * Npad * _F32
+    tiles = T1p * 16 * Npad * _F32
+    total = tab + band_w + moves + a_r + b_r + tab2 + tiles
+    if want_stats:
+        total += moves  # read back
+        total += T1p * 16 * Npad * _F32 + 8 * Npad * _F32  # stats tiles
+    cells = 2 * K * T1p * Npad
+    ops = cells * (8 + 2 * math.log2(K))  # fills
+    ops += T1p * Npad * K * (8 * (4 + 2 * math.log2(K)) + 10)  # dense
+    if want_stats:
+        ops += K * T1p * Npad * (10 + 4 * math.log2(K))
+    return {"bytes": float(total), "ops": float(ops),
+            "tab_bytes": float(tab + tab2),
+            "band_bytes": float(band_w + a_r + b_r),
+            "moves_bytes": float(2 * moves if want_stats else 0.0)}
 
 
 def utilization(nbytes: float, seconds: float) -> Dict[str, float]:

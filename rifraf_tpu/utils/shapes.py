@@ -35,6 +35,9 @@ _COL_CAPS = {
     "fill": lambda T1p: min(T1p, 512),
     "dense": lambda T1p: min(T1p // 2, 256),
     "stats": lambda T1p: min(T1p, 512),
+    # the single-launch megakernel chains fill -> dense -> stats through
+    # on-chip carry, so its per-step set is the max of both phases
+    "fused": lambda T1p: min(T1p // 2, 256),
 }
 
 
@@ -48,6 +51,14 @@ class BlockPlan(NamedTuple):
     n_steps: int  # T1p // cols
     vmem_bytes: int  # modelled double-buffered working set at `cols`
     vmem_budget: int  # the budget it was fit under
+
+    @property
+    def fits(self) -> bool:
+        """Whether even the chosen block width fits the budget. plan_cols
+        always returns cols >= 1; when the 1-column working set already
+        overflows, callers must decline the kernel (the megakernel falls
+        back to the split 3-launch path on this signal)."""
+        return self.vmem_bytes <= self.vmem_budget
 
 
 def _block_rows(kernel: str, c: int, K: int, want_moves: bool) -> int:
@@ -68,6 +79,16 @@ def _block_rows(kernel: str, c: int, K: int, want_moves: bool) -> int:
         # moves block C*K (int8 input still budgeted as f32: the kernel
         # widens on load) + seq table block (C+K) + out tiles C*16
         return c * K + (c + K) + c * _STAT_ROWS
+    if kernel == "fused":
+        # ops.fused_pallas megakernel: phase 1 holds both streams' table
+        # blocks + two fill tiles (+ the move tile with stats); phase 2
+        # holds the A tile, the (C+2)-column B window, the forward
+        # tables, the dense out tile (+ the move tile and stats tiles).
+        # ``want_moves`` here means the stats chain is fused in.
+        p1 = 10 * (c + K) + 2 * c * K + (c * K if want_moves else 0)
+        p2 = (c * K + (c + 2) * K + 5 * (c + K) + c * _STAT_ROWS
+              + ((c * K + c * _STAT_ROWS) if want_moves else 0))
+        return max(p1, p2)
     raise ValueError(f"unknown kernel: {kernel!r}")
 
 

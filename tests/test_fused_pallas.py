@@ -1,0 +1,181 @@
+"""Single-launch fused megakernel (ops.fused_pallas) vs the 3-launch
+split oracle, plus the mega/split routing guards.
+
+The bit-identity tests run the megakernel in Pallas interpret mode on
+CPU and compare EVERY output of the fused step — candidate tables,
+per-read scores, weighted total, and (stats on) n_errors + union edit
+indicators — against dense_pallas.fused_tables_pallas on the same
+inputs with np.testing.assert_array_equal (no tolerance): the megakernel
+chains fill -> dense -> stats through VMEM/ANY scratch instead of HBM
+round trips, and the chaining must not change a single bit. Comparisons
+cover only the defined regions (rows < tlen(+1), lanes < n_reads):
+padding lanes/columns are garbage by contract on both paths.
+
+Routing guards (fast suite): the megakernel declines to the split path
+when the env pins it, when the host traceback needs the exported move
+band, or when the chained working set cannot fit the VMEM budget.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = pytest.importorskip("jax.numpy")
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax, dense_pallas, fill_pallas, fused_pallas
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+
+
+def _problem(tlen=24, n_reads=4, bw=5, seed=3):
+    rng = np.random.default_rng(seed)
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n_reads):
+        slen = int(rng.integers(max(4, tlen - 5), tlen + 6))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -1.0, size=slen)
+        reads.append(make_read_scores(s, log_p, bw, SCORES))
+    return template, batch_reads(reads, dtype=np.float32)
+
+
+def _setup(template, batch):
+    tlen = len(template)
+    geom = align_jax.batch_geometry(batch, tlen)
+    K = fill_pallas.uniform_band_height(
+        np.asarray(geom.offset), np.asarray(geom.nd)
+    )
+    Tmax = ((tlen + 63) // 64) * 64
+    T1p = Tmax + 64
+    tpl = np.zeros(Tmax, np.int8)
+    tpl[:tlen] = template
+    return tlen, geom, K, T1p, tpl
+
+
+def _compare(tlen_n, n_reads, bw, seed, want_stats, zero_w=None):
+    template, batch = _problem(tlen=tlen_n, n_reads=n_reads, bw=bw,
+                               seed=seed)
+    tlen, geom, K, T1p, tpl = _setup(template, batch)
+    C = 8
+    weights = np.ones(batch.n_reads, np.float32)
+    if zero_w is not None:
+        weights[zero_w] = 0.0
+    args = (jnp.asarray(tpl), jnp.int32(tlen), _setup_bufs(batch), geom,
+            jnp.asarray(weights), K, T1p, C)
+    split = dense_pallas.fused_tables_pallas(
+        *args, want_stats=want_stats, interpret=True)
+    mega = fused_pallas.fused_tables_auto(
+        *args, want_stats=want_stats, interpret=True, impl="mega")
+    assert mega["impl"] == "mega"
+    N = batch.n_reads
+    T1 = tlen + 1
+    np.testing.assert_array_equal(
+        np.asarray(mega["scores"])[:N], np.asarray(split["scores"])[:N])
+    np.testing.assert_array_equal(
+        np.asarray(mega["total"]), np.asarray(split["total"]))
+    for name, hi in (("sub", tlen), ("ins", tlen + 1), ("del", tlen)):
+        np.testing.assert_array_equal(
+            np.asarray(mega[name])[:hi], np.asarray(split[name])[:hi],
+            err_msg=name)
+    if want_stats:
+        np.testing.assert_array_equal(
+            np.asarray(mega["n_errors"])[:N],
+            np.asarray(split["n_errors"])[:N])
+        np.testing.assert_array_equal(
+            np.asarray(mega["edits"])[:T1], np.asarray(split["edits"])[:T1])
+
+
+def _setup_bufs(batch):
+    Npad = ((batch.n_reads + 127) // 128) * 128
+    return fill_pallas.build_fill_buffers(
+        jnp.asarray(batch.seq), jnp.asarray(batch.match),
+        jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+        jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
+    )
+
+
+# ---- interpret-mode grid: megakernel vs 3-launch oracle (slow; the CI
+# kernels job runs these under both RIFRAF_TPU_FUSED_IMPL settings) ----
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("want_stats", [False, True])
+def test_mega_matches_split_oracle(want_stats):
+    """Multi-grid-step geometry (tlen=20 spans several C=8 column
+    blocks), stats chain off and on."""
+    _compare(20, 3, 4, 7, want_stats)
+
+
+@pytest.mark.slow
+def test_mega_matches_split_zero_weight_lane():
+    """A weight-0 read must drop out of the weighted tables and total
+    identically on both paths (the lane-packing masking contract)."""
+    _compare(24, 4, 5, 3, True, zero_w=1)
+
+
+@pytest.mark.slow
+def test_mega_matches_split_wide_band():
+    """bw=4 at tlen=16: band height comparable to the column block, so
+    phase-2 backward windows clamp at the buffer edge."""
+    _compare(16, 3, 4, 11, True)
+
+
+@pytest.mark.slow
+def test_mega_matches_split_long_template():
+    """tlen=40 crosses the T1p midpoint: exercises the clamped backward
+    window base and the per-lane roll realignment over many steps."""
+    _compare(40, 3, 4, 13, True)
+
+
+# ---- routing guards (fast): decline conditions are host arithmetic ----
+
+
+def test_mega_declines_when_vmem_budget_too_small():
+    """The planner guard: when plan_cols cannot fit the chained working
+    set (dual fill + dense join + stats tiles) in the VMEM budget even
+    at 1 column, the megakernel declines and routing falls back to the
+    split 3-launch path."""
+    ok, reason = fused_pallas.mega_eligible(128, 16, want_stats=True,
+                                            vmem_budget=4096)
+    assert not ok
+    assert "VMEM" in reason
+    sel, _ = fused_pallas.select_impl(128, 16, want_stats=True,
+                                      vmem_budget=4096, impl="mega")
+    assert sel == "split"
+
+
+def test_mega_eligible_at_default_budget():
+    ok, reason = fused_pallas.mega_eligible(128, 16, want_stats=True,
+                                            impl="mega")
+    assert ok and reason == "mega"
+    plan = fused_pallas.mega_plan(128, 16, want_stats=True)
+    assert plan.fits and plan.cols >= 1
+
+
+def test_mega_declines_on_want_moves():
+    """The SCORE-stage host traceback consumes the exported move band;
+    the megakernel keeps moves in launch-private scratch, so it must
+    route split."""
+    ok, reason = fused_pallas.mega_eligible(128, 16, want_moves=True,
+                                            impl="mega")
+    assert not ok and "moves" in reason
+
+
+def test_env_split_pins_oracle(monkeypatch):
+    monkeypatch.setenv("RIFRAF_TPU_FUSED_IMPL", "split")
+    assert fused_pallas.fused_impl() == "split"
+    sel, reason = fused_pallas.select_impl(128, 16)
+    assert sel == "split" and "RIFRAF_TPU_FUSED_IMPL" in reason
+    monkeypatch.delenv("RIFRAF_TPU_FUSED_IMPL")
+    assert fused_pallas.select_impl(128, 16)[0] == "mega"
+
+
+def test_mega_plan_scales_columns_with_budget():
+    """More VMEM -> at least as many columns per grid step; the fused
+    plan never exceeds the dense cap."""
+    small = fused_pallas.mega_plan(256, 16, vmem_budget=2 << 20)
+    big = fused_pallas.mega_plan(256, 16, vmem_budget=32 << 20)
+    assert big.cols >= small.cols
+    assert big.cols <= 128  # _COL_CAPS["fused"]: min(T1p // 2, 256)

@@ -1,0 +1,152 @@
+"""Lane packing as an execution strategy: packed multi-cluster launches
+must be bit-identical to per-problem runs, and the flush/accounting
+surfaces must report the packing honestly.
+
+Two layers:
+
+- Fast (host-only): the serve micro-batcher's lane-capacity flush
+  (``pending * Npad >= lane_target``), the ServerStats lane-occupancy
+  rollup, and the executed-lane accounting on SweepStats/BucketStats.
+- Slow (whole-sweep compiles): sweeps with the lane-packing floor
+  (``lane_target=128`` packs many small clusters into each launch) vs
+  one-cluster-per-launch sweeps (``lane_target=0, cluster_chunk=1``),
+  across mixed band geometries (different bandwidths and lengths) and
+  both ``do_alignment_proposals`` settings. Packing changes WHICH
+  launch a cluster rides in, never its result: pad clusters carry
+  weight 0 everywhere and band-height padding is masked by the band
+  geometry (the sweep module's core invariant).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from rifraf_tpu.models.errormodel import ErrorModel
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.parallel.sweep_sharded import (
+    _lane_slots,
+    sweep_clusters_sharded,
+)
+from rifraf_tpu.serve.batcher import MicroBatcher
+from rifraf_tpu.serve.request import Request, ServeConfig
+from rifraf_tpu.serve.stats import ServerStats
+from rifraf_tpu.sim.sample import sample_sequences
+from rifraf_tpu.utils.phred import phred_to_log_p
+
+SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+
+
+def _mixed_clusters(seed=0):
+    """Small clusters spanning several band geometries: bandwidths 4/9/
+    30 and lengths 45-75 produce distinct (Lpad, K0) signatures and
+    entry band heights."""
+    rng = np.random.default_rng(seed)
+    from rifraf_tpu.engine.params import RifrafParams
+
+    scores = RifrafParams().scores
+    out = []
+    for nseqs, length, bw in [(4, 50, 4), (5, 60, 9), (3, 45, 30),
+                              (6, 75, 9), (4, 52, 4), (5, 48, 4)]:
+        _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+            nseqs=nseqs, length=length, error_rate=0.03, rng=rng,
+            seq_errors=SEQ_ERRORS,
+        )
+        out.append([
+            make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                             bw, scores)
+            for s, p in zip(seqs, phreds)
+        ])
+    return out
+
+
+# ------------------------------------------------------ fast: host logic
+
+
+def _req(rid, key):
+    return Request(id=rid, cluster=[], info=None, key=key, t_submit=0.0,
+                   deadline=None)
+
+
+def test_batcher_lane_capacity_flush():
+    """A big-cluster bucket (Npad=64) flushes at 2 pending requests
+    (2 * 64 >= 128) instead of waiting for max_batch=16."""
+    b = MicroBatcher(ServeConfig(max_batch=16, lane_target=128))
+    k64 = (64, 128, 128, 32)
+    assert b.add(_req("a", k64)) is None
+    full = b.add(_req("b", k64))
+    assert full is not None and [r.id for r in full] == ["a", "b"]
+    assert b.depth() == 0
+
+
+def test_batcher_lane_flush_small_clusters_wait():
+    """Small clusters (Npad=8) underfill the lane axis, so the count
+    flush (max_batch) still governs: 15 pending at 8 lanes each stay
+    pending until the 16th arrives."""
+    b = MicroBatcher(ServeConfig(max_batch=16, lane_target=128))
+    k8 = (8, 64, 64, 16)
+    for i in range(15):
+        assert b.add(_req(f"r{i}", k8)) is None
+    assert b.add(_req("r15", k8)) is not None  # 16 * 8 == 128: both fire
+
+
+def test_batcher_lane_flush_disabled():
+    b = MicroBatcher(ServeConfig(max_batch=16, lane_target=0))
+    k64 = (64, 128, 128, 32)
+    for i in range(15):
+        assert b.add(_req(f"r{i}", k64)) is None
+
+
+def test_server_stats_lane_occupancy():
+    s = ServerStats()
+    s.note_batch(n_real=2, gp=2, useful_cells=100, padded_cells=200,
+                 useful_lanes=100, lane_slots=128, cluster_lanes=128)
+    s.note_batch(n_real=3, gp=4, useful_cells=100, padded_cells=400,
+                 useful_lanes=28, lane_slots=128, cluster_lanes=48)
+    snap = s.snapshot()
+    assert snap["lane_occupancy"] == pytest.approx(176 / 256)
+    assert snap["lane_occupancy_reads"] == pytest.approx(128 / 256)
+    s.note_model_bytes(2.5e9)
+    assert s.snapshot()["model_gb"] == pytest.approx(2.5)
+
+
+def test_lane_slots_rounding():
+    assert _lane_slots(16, 8) == 128
+    assert _lane_slots(1, 8) == 128  # a quarter-full tile still costs one
+    assert _lane_slots(2, 120) == 256  # 240 lanes -> two tiles
+    assert _lane_slots(17, 8) == 256
+
+
+# ------------------------------------- slow: packed vs per-problem sweeps
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("proposals", [False, True])
+def test_packed_sweep_matches_per_problem(proposals):
+    """The tentpole property: packing multiple small clusters into the
+    128-lane axis of one launch (lane_target=128 overriding
+    cluster_chunk=1) is bit-identical — consensus, score, iteration
+    count, convergence — to dispatching every cluster in its own launch
+    (lane_target=0, cluster_chunk=1), across mixed band geometries and
+    both candidate-proposal modes."""
+    clusters = _mixed_clusters(seed=3)
+    packed, pstats = sweep_clusters_sharded(
+        clusters, cluster_chunk=1, lane_target=128,
+        do_alignment_proposals=proposals, return_stats=True,
+    )
+    solo, sstats = sweep_clusters_sharded(
+        clusters, cluster_chunk=1, lane_target=0,
+        do_alignment_proposals=proposals, return_stats=True,
+    )
+    for g, (a, b) in enumerate(zip(packed, solo)):
+        assert np.array_equal(a.consensus, b.consensus), g
+        assert a.score == b.score, g
+        assert a.n_iters == b.n_iters, g
+        assert a.converged == b.converged, g
+    # packing is real: fewer launches, better lane fill at both levels
+    assert pstats.n_chunks < sstats.n_chunks
+    assert pstats.lane_occupancy > sstats.lane_occupancy
+    assert pstats.lane_occupancy_reads > sstats.lane_occupancy_reads
+    for bs in pstats.buckets:
+        assert bs.lane_slots == bs.n_chunks * _lane_slots(bs.gp, bs.key[0])
+        assert 0.0 < bs.lane_slot_occupancy <= 1.0

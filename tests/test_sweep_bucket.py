@@ -67,8 +67,10 @@ def test_plan_keys_on_grid_and_cover_members():
 
 def test_plan_pinned_chunk_shapes():
     """cluster_chunk splits every bucket into chunks PADDED TO ONE gp —
-    the executable-reuse fix: a tail chunk never gets its own shape."""
-    plans = plan_sweep(HET, cluster_chunk=2, n_axis=1)
+    the executable-reuse fix: a tail chunk never gets its own shape.
+    lane_target=0 isolates the invariant from the lane-packing floor
+    (which would lift these small buckets to one full-tile chunk)."""
+    plans = plan_sweep(HET, cluster_chunk=2, n_axis=1, lane_target=0)
     assert sum(len(p.chunks) for p in plans) > len(plans)  # chunking happened
     for p in plans:
         for ch in p.chunks:
@@ -99,8 +101,11 @@ def test_uniform_is_single_global_bucket():
 
 def test_bucketed_never_pads_more_than_uniform():
     """The point of the scheduler: heterogeneous inputs allocate fewer
-    padded device cells bucketed than uniform."""
-    bucketed = plan_cells(plan_sweep(HET))
+    padded device cells bucketed than uniform. lane_target=0 isolates
+    the bucketing invariant from the lane-packing coalescer, which
+    deliberately trades padded cells (reported as waste) for lane-tile
+    fill and fewer launches on tile-underfilled buckets."""
+    bucketed = plan_cells(plan_sweep(HET, lane_target=0))
     uniform = plan_cells(plan_sweep(HET, scheduler="uniform"))
     assert bucketed < uniform
     # homogeneous inputs: bucketing can't lose to within-grid rounding
@@ -113,6 +118,73 @@ def test_bucketed_never_pads_more_than_uniform():
 def test_unknown_scheduler_rejected():
     with pytest.raises(ValueError):
         plan_sweep(HET, scheduler="magic")
+
+
+def test_lane_target_fills_lane_tiles():
+    """The lane-packing floor: with a small cluster_chunk, a bucket of
+    small clusters (Npad=8) still packs ceil(128/8)=16 clusters per
+    chunk (bounded by member count), so each launch fills the 128-lane
+    axis instead of dispatching a quarter-full tile."""
+    many = [_cluster(5, 50) for _ in range(40)]  # one bucket, Npad=8
+    plans = plan_sweep(many, cluster_chunk=2, n_axis=1, lane_target=128)
+    assert len(plans) == 1
+    p = plans[0]
+    assert p.key[0] == 8
+    assert p.gp == 16  # ceil(128 / 8), overriding cluster_chunk=2
+    assert p.gp * p.key[0] >= 128
+    # bounded by membership: 3 members can't be packed to 16
+    few = [_cluster(5, 50) for _ in range(3)]
+    (pf,) = plan_sweep(few, cluster_chunk=2, n_axis=1, lane_target=128)
+    assert pf.gp == 3
+
+
+def test_lane_target_leaves_big_clusters_alone():
+    """A bucket that already fills the lane axis (Npad >= lane_target)
+    keeps its cluster_chunk-driven chunking."""
+    big = [_cluster(120, 50) for _ in range(8)]  # Npad=120 -> bucket 120
+    plans = plan_sweep(big, cluster_chunk=2, n_axis=1, lane_target=128)
+    (p,) = plans
+    assert p.gp == 2  # ceil(128/120)=2 == cluster_chunk — no inflation
+    # uniform scheduler ignores the floor entirely (legacy layout)
+    (pu,) = plan_sweep([_cluster(5, 50) for _ in range(40)],
+                       scheduler="uniform", cluster_chunk=2, n_axis=1,
+                       lane_target=128)
+    assert pu.gp == 2
+
+
+def test_lane_target_coalesces_underfilled_buckets():
+    """Buckets whose whole membership cannot fill one 128-lane tile are
+    merged into coarser-grid neighbours (and finally absorbed per
+    read-count class), so a ragtag of near-miss shapes shares fuller
+    launches instead of each paying a mostly-empty tile + a compile."""
+    # 8 tiny clusters spread over 8 distinct fine length buckets
+    ragtag = [_cluster(4, 40 + 70 * k) for k in range(8)]
+    fine = plan_sweep(ragtag, lane_target=0)
+    packed = plan_sweep(ragtag, lane_target=128)
+    assert len(fine) == 8
+    assert len(packed) < len(fine)
+    # coverage: every cluster in exactly one chunk, members in input
+    # order, and every merged key still covers its members' demands
+    seen = sorted(i for p in packed for ch in p.chunks for i in ch)
+    assert seen == list(range(len(ragtag)))
+    for p in packed:
+        flat = [i for ch in p.chunks for i in ch]
+        assert flat == sorted(flat)
+        for i in flat:
+            c = ragtag[i]
+            assert len(c) <= p.key[0]
+            assert max(len(r) for r in c) <= p.key[1]
+            assert len(c[0]) + 2 <= p.key[2]
+    # read-count classes never merge: a 4-read cluster stays in an
+    # Npad=8 bucket even after coalescing (coarsening Npad would pad
+    # every cluster's read lanes — the waste packing exists to avoid)
+    mixed = [_cluster(4, 40 + 30 * k) for k in range(4)] + [
+        _cluster(12, 40 + 30 * k) for k in range(4)
+    ]
+    for p in plan_sweep(mixed, lane_target=128):
+        npads = {8 if len(mixed[i]) <= 8 else 16
+                 for ch in p.chunks for i in ch}
+        assert npads == {p.key[0]}
 
 
 def test_pipeline_map_order_and_overlap():

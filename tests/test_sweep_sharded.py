@@ -88,7 +88,10 @@ def test_sweep_uneven_clusters(scheduler):
 def test_sweep_shuffled_inputs_restore_order():
     """Heterogeneous clusters landing in different shape buckets, fed in
     shuffled order: results come back in INPUT order, each bit-identical
-    to the per-cluster driver."""
+    to the per-cluster driver. lane_target=0 keeps the buckets distinct
+    (the lane-packing coalescer would merge these tile-underfilled
+    buckets into one launch — tests/test_lane_packing.py covers that
+    packed path)."""
     rng = np.random.default_rng(11)
     pool = []
     for nseqs, length, seed in [(4, 50, 1), (8, 90, 2), (5, 50, 3),
@@ -96,7 +99,8 @@ def test_sweep_shuffled_inputs_restore_order():
         c, _ = _clusters(1, nseqs=nseqs, length=length, seed=seed)
         pool.append(c[0])
     shuffled = [pool[i] for i in rng.permutation(len(pool))]
-    res, stats = sweep_clusters_sharded(shuffled, return_stats=True)
+    res, stats = sweep_clusters_sharded(shuffled, return_stats=True,
+                                        lane_target=0)
     assert stats.n_buckets > 1  # the permutation spans buckets
     assert len(res) == len(shuffled)
     for g, reads in enumerate(shuffled):

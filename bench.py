@@ -53,6 +53,7 @@ Other modes (results appended to BASELINE.md, not the driver JSON):
 """
 
 import json
+import os
 import sys
 import time
 
@@ -199,23 +200,45 @@ def roofline_stats(result):
 def ref_default_lane_stats():
     """Lane-occupancy read-back for a just-finished ref-default run: the
     device-loop stage runners record one fused_step entry per compiled
-    shape with the batch's real-read / padded-lane ratio (a 5-read INIT
-    batch fills 5/128 of the lane axis — the honest reason the CPU wins
-    this config; see CPU_REF_DEFAULT_SECONDS). None when no Pallas
-    stage runner was engaged (CPU/XLA backend)."""
+    shape with the batch's live-lane / padded-lane ratio (a 5-read INIT
+    batch fills 5/128 of the lane axis — the honest reason the CPU won
+    this config before segment-pair packing doubled the fill; see
+    CPU_REF_DEFAULT_SECONDS). Both Pallas and XLA stage runners record
+    (engine.realign), so the block reaches the BENCH JSON on every
+    backend. ``model_gb_effective`` discounts the padded-shape byte
+    model by the lane occupancy — the bytes spent on live lanes. None
+    when no stage runner was engaged (pure host loop)."""
     from rifraf_tpu.utils import roofline
 
     recs = [r for r in roofline.snapshot()
             if r["kernel"] == "fused_step" and r.get("lane_occupancy")]
     if not recs:
         return None
+    occ = min(r["lane_occupancy"] for r in recs)
+    gb = sum(r["model_bytes"] for r in recs) / len(recs) / 1e9
     return {
-        "lane_occupancy": round(min(r["lane_occupancy"] for r in recs), 4),
-        "model_gb_per_dispatch": round(
-            sum(r["model_bytes"] for r in recs) / len(recs) / 1e9, 3
-        ),
+        "lane_occupancy": round(occ, 4),
+        # the ref-default batch has no cluster-block padding (every live
+        # lane carries a real read), so read granularity matches
+        "lane_occupancy_reads": round(occ, 4),
+        "model_gb_per_dispatch": round(gb, 3),
+        "model_gb_effective": round(gb * occ, 3),
         "impl": recs[-1]["impl"],
     }
+
+
+def _with_segment_pack(value, fn):
+    """Run ``fn`` with RIFRAF_TPU_SEGMENT_PACK pinned (the packed vs
+    unpacked stage-batch comparison), restoring the prior setting."""
+    old = os.environ.get("RIFRAF_TPU_SEGMENT_PACK")
+    os.environ["RIFRAF_TPU_SEGMENT_PACK"] = value
+    try:
+        return fn()
+    finally:
+        if old is None:
+            os.environ.pop("RIFRAF_TPU_SEGMENT_PACK", None)
+        else:
+            os.environ["RIFRAF_TPU_SEGMENT_PACK"] = old
 
 
 def host_dispatch_stats(result, walls):
@@ -354,7 +377,10 @@ def _sweep_roofline(plans, results, seconds):
     (the vmapped while_loop runs until the chunk's last cluster
     converges); adaptation rounds are excluded, so the byte total is a
     floor and the pct a floor too."""
-    from rifraf_tpu.parallel.sweep_sharded import _lane_slots
+    from rifraf_tpu.parallel.sweep_sharded import (
+        SegmentBucketPlan,
+        _lane_slots,
+    )
     from rifraf_tpu.utils import roofline
     from rifraf_tpu.utils.shapes import plan_cols
 
@@ -366,7 +392,12 @@ def _sweep_roofline(plans, results, seconds):
             Tmax, K0, _lane_slots(p.gp, N), C
         )["bytes"]
         for ch in p.chunks:
-            steps = max((results[ci].n_iters for ci in ch), default=0)
+            # a segment-packed chunk's members sit inside PackPlans
+            members = (
+                [m[0] for pk in ch for m in pk.members]
+                if isinstance(p, SegmentBucketPlan) else ch
+            )
+            steps = max((results[ci].n_iters for ci in members), default=0)
             total += per_step * steps
     u = roofline.utilization(total, seconds)
     return {
@@ -649,9 +680,16 @@ def main():
         from rifraf_tpu.utils import roofline as _roofline
 
         _roofline.clear()
-        walls, it, rec, res = measure_e2e(n_timed=2, verbose=True,
-                                          ref_default=True)
+        # device_loop="on": off-TPU the auto gate would fall back to the
+        # host loop, where the packed/unpacked comparison measures
+        # nothing and no stage runner records lane stats
+        walls, it, rec, res = _with_segment_pack("1", lambda: measure_e2e(
+            n_timed=2, verbose=True, ref_default=True, device_loop="on"))
         lane = ref_default_lane_stats()
+        # the same stage batches without segment-pair packing: the
+        # rollback re-score as a conditional second dispatch
+        walls_u, _, _, _ = _with_segment_pack("0", lambda: measure_e2e(
+            n_timed=2, verbose=True, ref_default=True, device_loop="on"))
         # the same config pinned to the per-iteration host loop: what
         # each iteration pays in device round-trips (the latency the
         # device-resident stage loop amortizes into one dispatch/stage)
@@ -667,6 +705,11 @@ def main():
             "template_recovered": rec,
             "stage_paths": res.metadata["stage_paths"],
             "lane_stats": lane,
+            "stage_batch": {
+                "packed_s": round(min(walls), 3),
+                "unpacked_s": round(min(walls_u), 3),
+                "packed_vs_unpacked": round(min(walls_u) / min(walls), 2),
+            },
             "host_loop": dict(host_dispatch_stats(res_h, walls_h),
                               e2e_seconds=round(min(walls_h), 3)),
         }))
@@ -739,10 +782,25 @@ def main():
         # and the REFERENCE-DEFAULT parameter set (what cli/consensus.py
         # runs): fixed top-5 INIT batch, batch growth, alignment proposals
         _roofline.clear()
-        walls_rd, it_rd, rec_rd, res_rd = measure_e2e(
-            n_timed=2, verbose=verbose, ref_default=True
+        # device_loop="on": the stage-batch comparison needs the stage
+        # runner engaged (auto declines off-TPU, where the host loop
+        # would make packed vs unpacked a no-op measurement)
+        walls_rd, it_rd, rec_rd, res_rd = _with_segment_pack(
+            "1", lambda: measure_e2e(
+                n_timed=2, verbose=verbose, ref_default=True,
+                device_loop="on",
+            )
         )
         lane_rd = ref_default_lane_stats()
+        # the same stage batches with segment-pair packing off: the
+        # packed-vs-unpacked comparison rides the JSON alongside the
+        # lane stats
+        walls_ru, _, _, _ = _with_segment_pack(
+            "0", lambda: measure_e2e(
+                n_timed=2, verbose=verbose, ref_default=True,
+                device_loop="on",
+            )
+        )
         # per-iteration host-dispatch latency of the SAME config with
         # the device loop off: the round-trip cost the device-resident
         # stage loop removes
@@ -758,6 +816,11 @@ def main():
             "template_recovered": rec_rd,
             "stage_paths": res_rd.metadata["stage_paths"],
             "lane_stats": lane_rd,
+            "stage_batch": {
+                "packed_s": round(rd, 3),
+                "unpacked_s": round(min(walls_ru), 3),
+                "packed_vs_unpacked": round(min(walls_ru) / rd, 2),
+            },
             "host_loop": dict(host_dispatch_stats(res_rh, walls_rh),
                               e2e_seconds=round(min(walls_rh), 3)),
         }

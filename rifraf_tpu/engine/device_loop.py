@@ -237,6 +237,7 @@ def make_stage_runner(
     do_subs: bool = True,
     gate: str = "none",
     plan=None,
+    seg_step_fn: Callable = None,
 ):
     """Build the jitted whole-stage runner. ``step_fn`` takes the
     device-resident batch state as an ARGUMENT pytree (not a closure) so
@@ -254,7 +255,21 @@ def make_stage_runner(
     ``plan`` is opaque diagnostic metadata (the utils.shapes.BlockPlan
     the step was built with, for Pallas steps) attached to the returned
     runner as ``runner.plan`` so sweep/bench reporting can show which
-    VMEM blocking each cached stage program uses."""
+    VMEM blocking each cached stage program uses.
+
+    ``seg_step_fn`` (optional) scores SEVERAL candidate templates of
+    the same batch in ONE segment-packed dispatch:
+    ``(tmpls [2, Tmax], tlens [2], step_state) -> tables`` with a
+    leading segment axis on every leaf. When provided, the rollback
+    re-score packs {multi-applied, single-best} as two segments of one
+    launch (the reads duplicated per segment), instead of a
+    conditional second dispatch — on lane-starved solo runs (the
+    reference-default 5/20-read batches) the extra segment rides
+    otherwise-padded lanes for free, and one dispatch replaces two.
+    Values are unchanged: the per-segment reductions reproduce
+    ``step_fn``'s sums exactly (ops.fused.fused_step_segmented), and
+    the same rollback comparison selects the same winner — the
+    conditional path merely skipped computing the loser."""
 
     def cond(carry):
         return jnp.logical_not(carry["done"]) & (
@@ -306,6 +321,31 @@ def make_stage_runner(
             # handle_candidates: apply all chosen, re-score; if multiple
             # and the combination is no better than the best single,
             # roll back to the single best (which the next fill scores)
+            if seg_step_fn is not None:
+                # segment-packed pair: score multi + single-best in ONE
+                # dispatch (two segments over duplicated reads), then
+                # select — same values, half the dispatches
+                keep1 = keep & (jnp.cumsum(keep.astype(jnp.int32)) == 1)
+                tmpl1, tlen1 = _apply(
+                    tmpl, tlen, kind, pos, base, keep1, Tmax
+                )
+                outs = seg_step_fn(
+                    jnp.stack([tmpl_multi, tmpl1]),
+                    jnp.stack([tlen_multi, tlen1]),
+                    carry["step_state"],
+                )
+                total2 = outs[0][0]
+                rollback = (n_keep > 1) & (
+                    (total2 < best) | _isclose(total2, best)
+                )
+                pick = jax.tree_util.tree_map(
+                    lambda x: jnp.where(rollback, x[1], x[0]), outs
+                )
+                return (
+                    jnp.where(rollback, tmpl1, tmpl_multi),
+                    jnp.where(rollback, tlen1, tlen_multi),
+                    pick,
+                )
             out2 = step_fn(tmpl_multi, tlen_multi, carry["step_state"])
             total2 = out2[0]
             rollback = (n_keep > 1) & (
@@ -428,3 +468,217 @@ def make_stage_runner(
     runner.run = run
     runner.plan = plan
     return runner
+
+
+def make_segment_stage_runner(
+    step_fn: Callable,  # (tmpls [S,Tmax], tlens [S], state) -> per-seg tables
+    do_indels: bool,
+    min_dist: int,
+    H: int,
+    Tmax: int,
+    stop_on_same: bool,
+    n_seg: int,
+    do_subs: bool = True,
+    gate: str = "none",
+    plan=None,
+):
+    """Whole-stage runner for a SEGMENT-PACKED lane block: ``n_seg``
+    independent problems share one read block (utils.shapes
+    .pack_segments), each hill-climbing its own template, with ONE
+    segment-aware fused dispatch per iteration scoring every segment's
+    current candidate jointly (ops.fused.fused_step_segmented).
+
+    This is the hand-written equivalent of ``jax.vmap`` over
+    per-problem ``make_stage_runner`` loops — which is exactly what it
+    must stay bit-identical to (the per-problem baseline runs each
+    cluster in its own block). The while loop mirrors vmap's batching
+    rule for ``lax.while_loop``: the condition is ``any`` over the
+    per-segment predicates, the body computes every segment every
+    iteration, and finished segments' carries are frozen by a
+    per-segment select. ``lax.cond`` under vmap computes both branches
+    and selects — so the rollback re-score here scores BOTH the
+    multi-applied and single-best templates for every segment each
+    iteration (two segment-packed dispatches), matching the vmapped
+    program's values branch for branch. All per-segment scalar logic
+    (candidate scoring/selection/apply, history, stall checks) is the
+    SAME code as the per-problem runner, vmapped over the segment
+    axis.
+
+    ``step_fn`` takes per-segment templates ``[S, Tmax]`` / lengths
+    ``[S]`` plus the (shared) packed batch state, and returns the
+    tables tuple with a leading segment axis on every leaf:
+    ``(total [S], sub [S,T1,4], ins [S,T1,4], del [S,T1][, gates])``.
+
+    ``run(tmpl0 [S,Tmax], tlen0 [S], live [S], prev_score [S],
+    iters_left, prev_iters, step_state)`` returns one packed row per
+    segment (``unpack_stage_packed`` layout). Dead slots
+    (``live=False`` — padding when a block holds fewer than ``n_seg``
+    problems) start ``done`` and never iterate."""
+
+    if gate == "none":
+        def cand_fn(sub_t, ins_t, del_t, tmpl, tlen, total):
+            return _candidate_scores(
+                sub_t, ins_t, del_t, tmpl, tlen, total, do_indels,
+                Tmax, do_subs, gate, None,
+            )
+        cand_vmap = jax.vmap(cand_fn)
+    else:
+        def cand_fn(sub_t, ins_t, del_t, tmpl, tlen, total, gates):
+            return _candidate_scores(
+                sub_t, ins_t, del_t, tmpl, tlen, total, do_indels,
+                Tmax, do_subs, gate, gates,
+            )
+        cand_vmap = jax.vmap(cand_fn)
+    choose_vmap = jax.vmap(lambda c: _choose(c, min_dist))
+    apply_vmap = jax.vmap(
+        lambda tm, tl, k, p, b, kp: _apply(tm, tl, k, p, b, kp, Tmax)
+    )
+
+    def active_pred(carry):
+        return jnp.logical_not(carry["done"]) & (
+            carry["it"] < carry["iters_left"]
+        )
+
+    def cond(carry):
+        return jnp.any(active_pred(carry))
+
+    def body(carry):
+        pred = active_pred(carry)  # [S]
+        tmpl, tlen = carry["tmpl"], carry["tlen"]
+        total, sub_t, ins_t, del_t = carry["tables"][:4]
+        it = carry["it"]
+        hist = jax.vmap(
+            lambda h, t, i: jax.lax.dynamic_update_slice(
+                h, t[None], (i, jnp.zeros_like(i))
+            )
+        )(carry["hist"], tmpl, it)
+        hlen = jax.vmap(lambda hl, i, tl: hl.at[i].set(tl))(
+            carry["hlen"], it, tlen
+        )
+
+        if stop_on_same:
+            stop_same = ((it + carry["prev_iters"]) > 0) & (
+                total == carry["old_score"]
+            )
+        else:
+            stop_same = jnp.zeros((n_seg,), bool)
+
+        if gate == "none":
+            cand = cand_vmap(sub_t, ins_t, del_t, tmpl, tlen, total)
+        else:
+            cand = cand_vmap(
+                sub_t, ins_t, del_t, tmpl, tlen, total,
+                carry["tables"][4],
+            )
+        kind, pos, base, keep, n_improving, best = choose_vmap(cand)
+        no_cand = n_improving == 0
+        overflow = n_improving > CAP
+
+        tmpl_multi, tlen_multi = apply_vmap(
+            tmpl, tlen, kind, pos, base, keep
+        )
+        n_keep = jnp.sum(keep.astype(jnp.int32), axis=1)
+        drift = (tlen_multi + 1 >= Tmax) | (
+            jnp.abs(tlen_multi - carry["tlen0"]) > MAX_DRIFT
+        )
+        bail = (overflow | drift) & jnp.logical_not(stop_same | no_cand)
+        done = stop_same | no_cand | bail
+
+        # work: the vmapped cond computes both branches for every
+        # segment — two segment-packed dispatches, select per segment
+        keep1 = keep & (jnp.cumsum(keep.astype(jnp.int32), axis=1) == 1)
+        tmpl1, tlen1 = apply_vmap(tmpl, tlen, kind, pos, base, keep1)
+        out2 = step_fn(tmpl_multi, tlen_multi, carry["step_state"])
+        out1 = step_fn(tmpl1, tlen1, carry["step_state"])
+        rollback = (n_keep > 1) & (
+            (out2[0] < best) | _isclose(out2[0], best)
+        )
+
+        def sel(mask, a, b):
+            m = mask.reshape((n_seg,) + (1,) * (a.ndim - 1))
+            return jnp.where(m, a, b)
+
+        tmpl_w = sel(rollback, tmpl1, tmpl_multi)
+        tlen_w = jnp.where(rollback, tlen1, tlen_multi)
+        tables_w = jax.tree_util.tree_map(
+            lambda a, b: sel(rollback, a, b), out1, out2
+        )
+        tmpl_n = sel(done, tmpl, tmpl_w)
+        tlen_n = jnp.where(done, tlen, tlen_w)
+        tables_n = jax.tree_util.tree_map(
+            lambda old, new: sel(done, old, new),
+            carry["tables"], tables_w,
+        )
+
+        new = {
+            "tmpl": tmpl_n,
+            "tlen": tlen_n,
+            "tables": tables_n,
+            "old_score": total,
+            "done": done,
+            "bail": carry["bail"] | bail,
+            "it": it + jnp.where(done, 0, 1),
+            "n_rec": jnp.where(bail, it, it + 1),
+            "old_score_prev": carry["old_score"],
+            "hist": hist,
+            "hlen": hlen,
+            "tlen0": carry["tlen0"],
+            "iters_left": carry["iters_left"],
+            "prev_iters": carry["prev_iters"],
+            "step_state": carry["step_state"],
+        }
+        # freeze finished segments (vmap's while_loop masking rule)
+        frozen = {}
+        for k in new:
+            if k in ("iters_left", "prev_iters", "step_state"):
+                frozen[k] = new[k]
+            else:
+                frozen[k] = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(
+                        pred.reshape((n_seg,) + (1,) * (n.ndim - 1)),
+                        n, o,
+                    ),
+                    new[k], carry[k],
+                )
+        return frozen
+
+    @jax.jit
+    def run(tmpl0, tlen0, live, prev_score, iters_left, prev_iters,
+            step_state):
+        tables0 = step_fn(tmpl0, tlen0, step_state)
+        carry = {
+            "tmpl": tmpl0,
+            "tlen": tlen0,
+            "tables": tables0,
+            "old_score": prev_score.astype(tables0[0].dtype),
+            "done": jnp.logical_not(live),
+            "bail": jnp.zeros((n_seg,), bool),
+            "it": jnp.zeros((n_seg,), jnp.int32),
+            "n_rec": jnp.zeros((n_seg,), jnp.int32),
+            "hist": jnp.zeros((n_seg, H, Tmax), jnp.int8),
+            "hlen": jnp.zeros((n_seg, H), jnp.int32),
+            "tlen0": tlen0,
+            "iters_left": iters_left,
+            "prev_iters": prev_iters,
+            "step_state": step_state,
+            "old_score_prev": prev_score.astype(tables0[0].dtype),
+        }
+        out = jax.lax.while_loop(cond, body, carry)
+        pdt = out["tables"][0].dtype
+        head = jnp.stack([
+            out["tlen"].astype(pdt),
+            out["tables"][0],
+            out["n_rec"].astype(pdt),
+            (out["done"] & jnp.logical_not(out["bail"])).astype(pdt),
+            jnp.where(out["bail"], out["old_score_prev"],
+                      out["tables"][0]).astype(pdt),
+        ], axis=1)
+        return jnp.concatenate([
+            head,
+            out["hlen"].astype(pdt),
+            out["hist"].astype(pdt).reshape(n_seg, -1),
+            out["tmpl"].astype(pdt),
+        ], axis=1)
+
+    run.plan = plan
+    return run

@@ -611,12 +611,28 @@ class BatchAligner:
             from ..ops.fused_pallas import select_impl
 
             impl = select_impl(T1p, K, want_stats=use_edits)[0]
+        n_reads = self.batch.n_reads
+        # segment-pair packing of the rollback re-score (the ref-default
+        # self-packing): only on the XLA step, only when the batch is
+        # lane-starved enough that the duplicated reads ride padded
+        # lanes, never through the read-chunked step (chunked partial
+        # sums associate differently), and within the unblocked dense
+        # sweep. In the runner key: the env gate can flip mid-process
+        from ..ops.fused import DENSE_BLOCK_THRESHOLD
+        from ..parallel.sweep_sharded import segment_pack_enabled
+
+        chunk0 = _pick_read_chunk(n_reads, K, T1, self.hbm_budget)
+        seg_pair = (
+            not use_pallas
+            and segment_pack_enabled()
+            and (not chunk0 or chunk0 >= n_reads)
+            and 2 * n_reads <= 128
+            and T1 <= DENSE_BLOCK_THRESHOLD
+        )
         key = (Tmax, K, use_pallas, do_indels, min_dist, history_cap,
-               stop_on_same, use_edits, impl)
+               stop_on_same, use_edits, impl, seg_pair)
         if key in self._stage_runners:
             return self._stage_runners[key]
-
-        n_reads = self.batch.n_reads
         bw_dev = jnp.asarray(self.bandwidths)
         lengths_dev = jnp.asarray(self._lengths_host)
 
@@ -636,8 +652,14 @@ class BatchAligner:
             weights = jnp.ones(n_reads, dtype=self.dtype)
             base = _xla_stage_runner(
                 K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
-                history_cap, stop_on_same, use_edits,
+                history_cap, stop_on_same, use_edits, seg_pair,
             )
+            # one roofline record per compiled shape (like the Pallas
+            # branch): lane occupancy against the 128-lane vector axis,
+            # with segment-pair packing the re-score rides 2x the lanes
+            n_live = 2 * n_reads if seg_pair else n_reads
+            _dense_cols(_bucket(T1, 64), K, Npad=_bucket(n_live, 128),
+                        want_stats=use_edits, impl="xla", n_live=n_live)
             state = (
                 (batch.seq, batch.match, batch.mismatch, batch.ins,
                  batch.dels),
@@ -1341,12 +1363,25 @@ def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
 
 @functools.lru_cache(maxsize=64)
 def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
-                      history_cap, stop_on_same, use_edits=False):
+                      history_cap, stop_on_same, use_edits=False,
+                      seg_pair=False):
     """Compiled device stage loop over the fused XLA scan step (any
     backend / f64 exactness runs). step_state = ((seq, match, mismatch,
-    ins, dels), lengths, bandwidths, weights)."""
+    ins, dels), lengths, bandwidths, weights).
+
+    ``seg_pair`` packs the rollback re-score as a two-segment launch
+    (ops.fused.fused_step_segmented over the reads duplicated per
+    segment): on lane-starved small batches — the reference-default
+    driver's 5-candidate/20-read stage sub-batches — the second
+    template rides otherwise-padded lanes, replacing the conditional
+    second dispatch. Bit-identical to the conditional path: segment 0's
+    reductions walk the same lanes in the same order with exact zeros
+    in segment 1's lanes (the unchunked fused step and the segmented
+    step share _dense_batch/masked_weighted_sum)."""
+    import jax.numpy as jnp
+
     from ..ops.align_jax import BandGeometry
-    from ..ops.fused import fused_step_full, unpack_tables
+    from ..ops.fused import fused_step_full, fused_step_segmented, unpack_tables
     from .device_loop import make_stage_runner
 
     def step_fn(tmpl, tlen, s):
@@ -1358,9 +1393,36 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
         )
         return unpack_tables(packed, n_reads, T1, use_edits)
 
+    seg_step = None
+    if seg_pair:
+
+        def seg_step(tmpls, tlens, s):
+            (seq, match, mismatch, ins, dels), lengths, bw, weights = s
+
+            def two(a):
+                return jnp.concatenate([a, a], axis=0)
+
+            seg = jnp.concatenate([
+                jnp.zeros((n_reads,), jnp.int32),
+                jnp.ones((n_reads,), jnp.int32),
+            ])
+            out = fused_step_segmented(
+                tmpls[:, :Tmax], tlens, seg, two(seq), two(match),
+                two(mismatch), two(ins), two(dels), two(lengths),
+                two(bw), two(weights), K, 2,
+                want_stats=use_edits, want_tables=True,
+            )
+            tables = (out["total"], out["sub"], out["ins"], out["del"])
+            if use_edits:
+                # the plain step's edits come back through the packed
+                # float buffer (unpack_tables); match that dtype so the
+                # rollback cond's two branches carry identical types
+                tables += (out["edits"].astype(out["sub"].dtype),)
+            return tables
+
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
-        gate="edits" if use_edits else "none",
+        gate="edits" if use_edits else "none", seg_step_fn=seg_step,
     )
 
 

@@ -422,7 +422,16 @@ def fused_tables_pallas(
         p["tlen_s"], p["off_s"], meta3, A_flat, Bh, p["fwd_tabs"], w,
         K, T1p, C, interpret=interpret,
     )
-    total = jnp.sum(jnp.where(w > 0, scores, 0.0) * w)
+    # the one epilogue lane reduction of the split path (tables reduce
+    # in-kernel), routed through the shared segment-reduce helper in
+    # its trivial single-segment form — bit-identical to the plain
+    # masked weighted sum, and the same code path a segment-packed
+    # epilogue would take
+    from .fused import segment_masked_sum_lanes, segment_weights
+
+    total = segment_masked_sum_lanes(
+        segment_weights(jnp.zeros((Npad,), jnp.int32), w, 1), scores
+    )[0]
     out = {
         "total": total, "scores": scores,
         "sub": sub_t, "ins": ins_t, "del": del_t,

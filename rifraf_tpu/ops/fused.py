@@ -224,6 +224,145 @@ def fused_step_full(
     return None, None, moves, _pack(comp, match.dtype, want_stats)
 
 
+def segment_weights(seg_ids, weights, n_seg: int):
+    """Per-segment weight rows [S, N]: ``weights`` where the lane
+    belongs to segment ``s``, exact zero elsewhere. The segment-reduce
+    primitive every packed reduction builds on: a per-segment masked
+    sum walks the SAME lane axis in the same order as the per-problem
+    reduction, with exact zeros in foreign lanes — adding 0.0 is exact
+    and order-preserving reductions keep the real summands' partial-sum
+    structure, so per-segment results are bit-identical to per-problem
+    runs (tests/test_lane_packing.py)."""
+    return jnp.where(
+        seg_ids[None, :] == jnp.arange(n_seg)[:, None],
+        weights[None, :],
+        jnp.zeros((), weights.dtype),
+    )
+
+
+def segment_masked_sum(seg_w, x):
+    """Segment-reduce variant of ``masked_weighted_sum``: one weighted
+    read-axis sum per segment row of ``seg_w`` [S, N] -> [S, ...]."""
+    return jax.vmap(lambda w: masked_weighted_sum(w, x))(seg_w)
+
+
+def segment_masked_sum_lanes(seg_w, x):
+    """Lane-LAST segment reduce: ``x [..., N]`` summed over its last
+    axis per segment row of ``seg_w [S, N]`` -> ``[S, ...]``. The
+    Pallas epilogues keep the lane axis last (tile layout), so this is
+    their variant of ``segment_masked_sum`` — same mask-before-multiply
+    discipline, same in-order lane walk, so the single-segment case is
+    bit-identical to the unsegmented ``sum(where(w > 0, x, 0) * w)``."""
+    return jax.vmap(
+        lambda w: jnp.sum(jnp.where(w > 0, x, jnp.zeros((), x.dtype)) * w,
+                          axis=-1)
+    )(seg_w)
+
+
+def segment_union_max_lanes(seg_ids, x, n_seg: int):
+    """Per-segment max-union over a lane-last axis: ``x [..., N]`` ->
+    ``[S, ...]`` with foreign lanes replaced by exact zeros. The edits
+    union has no weight mask — pad lanes must duplicate a read of their
+    assigned segment slot (the packing convention), making their
+    indicators a no-op in the union."""
+    mask = seg_ids[None, :] == jnp.arange(n_seg)[:, None]
+    return jax.vmap(
+        lambda m: jnp.max(jnp.where(m, x, jnp.zeros((), x.dtype)), axis=-1)
+    )(mask)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("K", "n_seg", "want_stats", "want_tables"),
+)
+def fused_step_segmented(
+    templates, tlens, seg_ids, seq, match, mismatch, ins, dels,
+    lengths, bandwidths, weights, K, n_seg,
+    want_stats=False, want_tables=True,
+):
+    """The fused step for a SEGMENT-PACKED lane block: multiple
+    independent problems share one ``[N]`` read block, identified by a
+    per-lane problem id (``utils.shapes.pack_segments``), and every
+    lane-axis reduction is segment-aware.
+
+    ``templates [S, Tmax]`` / ``tlens [S]`` hold one template per
+    segment slot; each lane scores against ITS segment's template
+    (``templates[seg_ids]`` — the per-lane fills are already
+    independent per read, so packing changes nothing there). Per-lane
+    band frames come from ``BandGeometry.make`` with the gathered
+    per-lane template length. Reductions run per segment with
+    zero-masked foreign lanes (see ``segment_weights``): results are
+    bit-identical to running each segment in its own block.
+
+    Returns a dict: ``total [S]``, per-lane ``scores [N]``, dense
+    tables ``sub/ins [S, T1, 4]``, ``del [S, T1]``; with ``want_stats``
+    also per-lane ``n_errors [N]`` and the per-segment edits union
+    ``edits [S, T1, 9]``. Pad lanes must carry weight 0 AND duplicate a
+    read of their assigned segment slot (the edits union has no weight
+    mask — a duplicate's indicators are a no-op, exactly the
+    per-problem padding convention).
+
+    Declines (raises) on templates long enough for the blocked dense
+    sweep — ``dense_tables_blocked`` reduces internally at full lane
+    width, so the packer routes those problems to whole-block
+    execution instead.
+    """
+    from . import align_jax
+
+    Tmax = templates.shape[1]
+    T1 = Tmax + 1
+    if want_tables and T1 > DENSE_BLOCK_THRESHOLD:
+        raise NotImplementedError(
+            "segment packing declines blocked-dense templates "
+            f"(T1={T1} > {DENSE_BLOCK_THRESHOLD})"
+        )
+    t_lane = templates[seg_ids]  # [N, Tmax]
+    geom = align_jax.BandGeometry.make(
+        lengths, tlens[seg_ids], bandwidths
+    )
+    fwd_bwd = jax.vmap(
+        align_jax._fwd_bwd_one,
+        in_axes=(0, 0, 0, 0, 0, 0, 0, None, None),
+    )
+    A, moves, scores, B = fwd_bwd(
+        t_lane, seq, match, mismatch, ins, dels, geom, K, want_stats
+    )
+    A, B = _fill_barrier((A, B))
+
+    seg_w = segment_weights(seg_ids, weights, n_seg)
+    out = {
+        "total": jax.vmap(
+            lambda w: jnp.sum(jnp.where(w > 0, scores, 0.0) * w)
+        )(seg_w),
+        "scores": scores,
+    }
+    if want_tables:
+        subs, insr, dele = _dense_batch(
+            A, B, seq, match, mismatch, ins, dels, geom
+        )
+        out["sub"] = segment_masked_sum(seg_w, subs)
+        out["ins"] = segment_masked_sum(seg_w, insr)
+        out["del"] = segment_masked_sum(seg_w, dele)
+    else:
+        out["sub"] = jnp.zeros((n_seg, 0, 4), A.dtype)
+        out["ins"] = jnp.zeros((n_seg, 0, 4), A.dtype)
+        out["del"] = jnp.zeros((n_seg, 0), A.dtype)
+    if want_stats:
+        stats = jax.vmap(
+            align_jax._traceback_stats_one, in_axes=(0, 0, 0, 0, None)
+        )
+        nerr, edits = stats(moves, seq, t_lane, geom, K)
+        out["n_errors"] = nerr
+        mask = seg_ids[None, :] == jnp.arange(n_seg)[:, None]
+        out["edits"] = jax.vmap(
+            lambda m: jnp.max(
+                jnp.where(m[:, None, None], edits, jnp.zeros((), edits.dtype)),
+                axis=0,
+            )
+        )(mask)
+    return out
+
+
 def pack_layout(n_reads: int, T1: int, want_stats: bool,
                 want_tables: bool = True):
     """Slice map of fused_step_full's packed array: name -> (start, stop)."""

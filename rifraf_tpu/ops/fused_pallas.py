@@ -138,6 +138,23 @@ def mega_eligible(T1p: int, K: int, want_stats: bool = False,
     return True, "mega"
 
 
+def mega_segment_eligible(n_seg: int):
+    """(ok, reason) for a SEGMENT-PACKED launch on the megakernel: the
+    kernel streams ONE template's columns through its fill/dense/stats
+    phases, so a multi-template packed block (one template per segment,
+    ops.fused.fused_step_segmented) has no single-launch program here —
+    the planner routes those to the XLA segmented step. The trivial
+    single-segment case is just the normal launch (its epilogue already
+    runs through the shared segment-reduce helpers)."""
+    if n_seg > 1:
+        return False, (
+            f"segment-packed launch (n_seg={n_seg}): the megakernel "
+            "fills one template per launch; multi-template packed "
+            "blocks run the XLA segmented step"
+        )
+    return True, "mega"
+
+
 def select_impl(T1p: int, K: int, want_stats: bool = False,
                 want_moves: bool = False, vmem_budget=None, impl=None):
     """("mega"|"split", reason) — the single routing decision shared by
@@ -791,7 +808,23 @@ def fused_tables_mega(
 ):
     """One fused consensus step in a SINGLE Pallas launch — same dict
     contract as dense_pallas.fused_tables_pallas (minus want_moves,
-    which declines to the split path in fused_tables_auto)."""
+    which declines to the split path in fused_tables_auto).
+
+    The kernel body emits PER-LANE values; every lane-axis reduction
+    lives in this epilogue and runs through the shared segment-reduce
+    helpers (ops.fused.segment_masked_sum_lanes / _union_max_lanes) in
+    their trivial single-segment form — one segment spanning all lanes
+    reduces with the exact formula and lane order of the unsegmented
+    sum, so routing through the helpers is bit-identical. Multi-segment
+    launches decline here (mega_segment_eligible): the kernel streams
+    one template's columns, so packed multi-template blocks run the XLA
+    segmented step instead."""
+    from .fused import (
+        segment_masked_sum_lanes,
+        segment_union_max_lanes,
+        segment_weights,
+    )
+
     Npad = bufs.seq_T.shape[1]
     NB = Npad // LANES
     n_steps = T1p // C
@@ -813,10 +846,11 @@ def fused_tables_mega(
         T1p, ROWS, NB * LANES
     )
     w = _pad_lanes(weights.astype(jnp.float32), Npad)
-    ww = w[None, None, :]
-    tables = jnp.sum(jnp.where(ww > 0, per_lane, 0.0) * ww, axis=2)
+    seg0 = jnp.zeros((Npad,), jnp.int32)  # one segment = all lanes
+    seg_w = segment_weights(seg0, w, 1)
+    tables = segment_masked_sum_lanes(seg_w, per_lane)[0]
     scores = scores2[0, :Npad]
-    total = jnp.sum(jnp.where(w > 0, scores, 0.0) * w)
+    total = segment_masked_sum_lanes(seg_w, scores)[0]
     out = {
         "total": total, "scores": scores,
         "sub": tables[:, 1:5], "ins": tables[:, 5:9], "del": tables[:, 0],
@@ -826,7 +860,9 @@ def fused_tables_mega(
         acc = outs.pop(0)
         T1 = template.shape[0] + 1
         out["n_errors"] = _finish_nerr(acc, Npad)
-        um = jnp.max(tiles.reshape(T1p, ROWS, NB * LANES), axis=2)[:T1]
+        um = segment_union_max_lanes(
+            seg0, tiles.reshape(T1p, ROWS, NB * LANES), 1
+        )[0][:T1]
         out["edits"] = _edits_from_union(um > 0.0)
     return out
 

@@ -60,6 +60,7 @@ and weight-0 pad reads/clusters drop out of every reduction.
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
@@ -67,7 +68,7 @@ import numpy as np
 
 from ..models.sequences import ReadScores, batch_reads
 from ..utils.mathops import logsumexp10, poisson_cquantile
-from ..utils.shapes import LANES
+from ..utils.shapes import LANES, pack_segments
 from ..utils.shapes import bucket as _bucket
 from .cluster import pipeline_map
 
@@ -81,6 +82,18 @@ BAND_BUCKET = 16
 # sized to fill at least one full lane tile when the bucket has the
 # members (plan_sweep lane_target)
 LANE_TARGET = LANES
+# segment packing declines templates long enough for the blocked dense
+# sweep, whose internal reductions are full-lane-width — must equal
+# ops.fused.DENSE_BLOCK_THRESHOLD (pinned by tests/test_lane_packing.py;
+# duplicated here so plan_sweep stays importable without JAX)
+SEG_TMAX_MAX = 2048
+
+
+def segment_pack_enabled() -> bool:
+    """Env opt-out for segment packing (``RIFRAF_TPU_SEGMENT_PACK=0``).
+    Resolved OUTSIDE jit — it selects which host-side plan and which
+    lru-cached program factory run, never a traced branch."""
+    return os.environ.get("RIFRAF_TPU_SEGMENT_PACK", "1") != "0"
 
 
 def _lane_slots(gp: int, n: int, lanes: int = LANES) -> int:
@@ -153,6 +166,31 @@ class BucketPlan(NamedTuple):
     band: int  # band-height grid for this bucket's K choices
     gp: int  # cluster-axis size every chunk is padded to
     chunks: List[List[int]]  # input indices per chunk, input order
+
+
+class PackPlan(NamedTuple):
+    """One segment-packed lane block: which clusters share it and
+    where their read lanes sit."""
+
+    members: List[Tuple[int, int, int]]  # (cluster idx, lane offset, n)
+    seg_ids: List[int]  # [Npad] per-lane segment-slot id
+
+
+class SegmentBucketPlan(NamedTuple):
+    """A shape bucket executed with READ-GRANULARITY segment packing:
+    several small clusters share each ``[Npad]`` lane block, located by
+    a per-lane segment mask, instead of one whole
+    ``bucket(n_reads, read_bucket)`` block each. Produced by
+    ``plan_sweep`` for clusters too small to fill a lane tile alone;
+    executed by ``ChunkExecutor`` through the segment-aware fused step
+    (ops.fused.fused_step_segmented) and the hand-batched segment
+    stage runner (engine.device_loop.make_segment_stage_runner)."""
+
+    key: Tuple[int, int, int, int]  # (Npad, Lpad, Tmax, K0)
+    band: int
+    sp: int  # static segment axis: max clusters per pack
+    gp: int  # packs per chunk (pinned; cluster_chunk bounds PACKS here)
+    chunks: List[List[PackPlan]]
 
 
 class _ClusterInfo(NamedTuple):
@@ -285,6 +323,8 @@ def plan_sweep(
     n_axis: int = 1,
     infos: Optional[List[_ClusterInfo]] = None,
     lane_target: int = LANE_TARGET,
+    segment_pack: Optional[bool] = None,
+    segment_align: int = 1,
 ) -> List[BucketPlan]:
     """Group clusters into shape buckets and chunk each bucket's cluster
     axis. Pure host arithmetic — no JAX — so planner invariants are
@@ -310,6 +350,21 @@ def plan_sweep(
     WHOLE membership cannot fill one tile are first coalesced into
     coarser-grid neighbours (see _coalesce_underfilled). 0 disables
     both.
+
+    ``segment_pack`` (default: the ``RIFRAF_TPU_SEGMENT_PACK`` env
+    gate, on unless set to ``0``) packs at READ granularity instead of
+    flooring to whole blocks: clusters too small to fill a lane tile
+    alone (``bucket(n_reads, read_bucket) < lane_target``) are grouped
+    by their SHAPE axes (Lpad, Tmax, K0) and first-fit packed into
+    shared ``[Npad]`` blocks (utils.shapes.pack_segments), each lane
+    tagged with its cluster's segment id — a 5-read and an 11-read
+    cluster share 16 lanes instead of riding 8+16. The packer declines
+    (whole-block path) for clusters that fill a tile alone and for
+    templates long enough for the blocked dense sweep
+    (``SEG_TMAX_MAX``), whose internal reductions are not
+    segment-aware. ``segment_align`` > 1 rounds each cluster's lane
+    footprint (see pack_segments — for backends with tree-shaped lane
+    reductions).
     """
     if scheduler not in ("bucketed", "uniform"):
         raise ValueError(f"unknown sweep scheduler: {scheduler!r}")
@@ -335,16 +390,80 @@ def plan_sweep(
         # bucket to a fixed grid can cost more cells than the uniform
         # layout it is supposed to beat
         grid = max(n_axis, 1)
+        if segment_pack is None:
+            segment_pack = lane_target > 0 and segment_pack_enabled()
+        seg_groups = {}
         groups = {}
         for i, info in enumerate(infos):
             key = bucket_key(info, read_bucket, band, len_bucket)
-            groups.setdefault(key, []).append(i)
+            if (
+                segment_pack
+                and lane_target > 0
+                and key[0] < lane_target
+                and key[2] + 1 <= SEG_TMAX_MAX
+            ):
+                seg_groups.setdefault(key[1:], []).append(i)
+            else:
+                groups.setdefault(key, []).append(i)
+        # mesh decline: a segment-packed group executes on its PACK
+        # axis, and the mesh shards that axis — packing a group into
+        # fewer packs than the mesh could otherwise fill serializes
+        # devices the whole-block path would use (one cluster per
+        # slot). Route such groups back to whole-block bucketing (a
+        # structural decline, independent of the env gate).
+        if max(n_axis, 1) > 1:
+            for shape_key in list(seg_groups):
+                members = seg_groups[shape_key]
+                pk = pack_segments(
+                    [infos[i].n_reads for i in members],
+                    lanes=lane_target, align=segment_align,
+                )
+                if (len(pk.blocks) < n_axis
+                        and len(members) > len(pk.blocks)):
+                    for i in members:
+                        groups.setdefault(
+                            bucket_key(infos[i], read_bucket, band,
+                                       len_bucket), []
+                        ).append(i)
+                    del seg_groups[shape_key]
         if lane_target > 0:
             groups = _coalesce_underfilled(
                 groups, infos, read_bucket, band, len_bucket, lane_target
             )
 
     plans = []
+    if scheduler == "bucketed":
+        for shape_key, members in seg_groups.items():
+            pk = pack_segments(
+                [infos[i].n_reads for i in members],
+                lanes=lane_target,
+                align=segment_align,
+            )
+            npad = _bucket(pk.npad, read_bucket)
+            packs = []
+            for b, blk in enumerate(pk.blocks):
+                packs.append(PackPlan(
+                    members=[
+                        (members[li], off, n) for li, off, n in blk
+                    ],
+                    seg_ids=(
+                        pk.seg_ids[b] + [0] * (npad - len(pk.seg_ids[b]))
+                    ),
+                ))
+            target = (
+                min(len(packs), cluster_chunk) if cluster_chunk
+                else len(packs)
+            )
+            gp = _bucket(max(target, 1), max(n_axis, 1))
+            plans.append(SegmentBucketPlan(
+                key=(npad,) + shape_key,
+                band=band_bucket,
+                sp=pk.n_seg,
+                gp=gp,
+                chunks=[
+                    packs[s : s + gp] for s in range(0, len(packs), gp)
+                ],
+            ))
     for key, members in groups.items():
         target = min(len(members), cluster_chunk) if cluster_chunk else (
             len(members)
@@ -430,6 +549,73 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
         )(t0, tl, step_state)
 
     return jax.jit(call, donate_argnums=(2,) if donate else ())
+
+
+@functools.lru_cache(maxsize=None)
+def _seg_adapt_program(Tmax: int, K: int, S: int):
+    """Segment-packed adaptive-bandwidth round: per-lane traceback
+    error counts for a chunk of packs, each lane filled against ITS
+    segment's template. Per-lane values are identical to the
+    whole-block adapt program's (the fills are independent per read)."""
+    import jax
+
+    from ..ops.fused import fused_step_segmented
+
+    def one(seq_g, match_g, mismatch_g, ins_g, dels_g, lengths_g, bw_g,
+            w_g, seg_g, tmpl_g, tlen_g):
+        out = fused_step_segmented(
+            tmpl_g, tlen_g, seg_g, seq_g, match_g, mismatch_g, ins_g,
+            dels_g, lengths_g, bw_g, w_g, K, S,
+            want_stats=True, want_tables=False,
+        )
+        return out["n_errors"]
+
+    return jax.jit(jax.vmap(one))
+
+
+@functools.lru_cache(maxsize=None)
+def _seg_stage_program(Tmax: int, K: int, H: int, min_dist: int,
+                       use_edits: bool, donate: bool, S: int):
+    """The whole INIT stage for a chunk of SEGMENT-PACKED blocks: S
+    clusters share each block's lane axis, hill-climbing jointly via
+    the segment stage runner, vmapped over the pack axis. Same cache
+    discipline as _stage_program: one program per (shape, S)
+    signature."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.device_loop import make_segment_stage_runner
+    from ..ops.fused import fused_step_segmented
+
+    def step_fn(tmpls, tlens, s):
+        (seq_g, match_g, mismatch_g, ins_g, dels_g), lengths_g, bw_g, \
+            w_g, seg_g = s
+        out = fused_step_segmented(
+            tmpls, tlens, seg_g, seq_g, match_g, mismatch_g, ins_g,
+            dels_g, lengths_g, bw_g, w_g, K, S,
+            want_stats=use_edits, want_tables=True,
+        )
+        tabs = (out["total"], out["sub"], out["ins"], out["del"])
+        if use_edits:
+            tabs = tabs + (out["edits"],)
+        return tabs
+
+    run = make_segment_stage_runner(
+        step_fn, do_indels=True, min_dist=min_dist, H=H, Tmax=Tmax,
+        stop_on_same=True, n_seg=S,
+        gate="edits" if use_edits else "none",
+    )
+
+    def call(t0, tl, live, step_state):
+        prev = jnp.full((S,), -jnp.inf)
+        return jax.vmap(
+            lambda a, b, lv, s: run(
+                a, b, lv, prev, jnp.int32(H - 1), jnp.int32(0), s
+            ),
+            in_axes=(0, 0, 0, ((0, 0, 0, 0, 0), 0, 0, 0, 0)),
+        )(t0, tl, live, step_state)
+
+    return jax.jit(call, donate_argnums=(3,) if donate else ())
 
 
 class ChunkExecutor:
@@ -625,6 +811,174 @@ class ChunkExecutor:
             ))
         return results
 
+    def pack_seg(self, plan: SegmentBucketPlan, packs: Sequence[PackPlan],
+                 clusters, infos) -> dict:
+        """Host side of one SEGMENT-PACKED chunk: each pack's lane
+        block holds several clusters' reads at their planned offsets,
+        gap/pad lanes repeat the pack's first read at weight 0 (a
+        duplicate of a real read, so the edits union and every masked
+        reduction are untouched — the same padding convention as
+        whole-block packing). Per-SEGMENT seed templates/lengths ride
+        alongside, plus the per-lane segment-id mask."""
+        N, L, Tmax, _ = plan.key
+        Gp, S = plan.gp, plan.sp
+        dtype = self.dtype
+        seqs = np.zeros((Gp, N, L), np.int8)
+        match = np.zeros((Gp, N, L), dtype)
+        mismatch = np.zeros((Gp, N, L), dtype)
+        ins = np.zeros((Gp, N, L), dtype)
+        dels = np.zeros((Gp, N, L + 1), dtype)
+        lengths = np.zeros((Gp, N), np.int32)
+        weights = np.zeros((Gp, N), dtype)
+        bandwidths = np.zeros((Gp, N), np.int32)
+        est_err = np.zeros((Gp, N), np.float64)
+        seg_ids = np.zeros((Gp, N), np.int32)
+        tlens0 = np.zeros((Gp, S), np.int32)
+        tmpl0 = np.zeros((Gp, S, Tmax), np.int8)
+        live = np.zeros((Gp, S), bool)
+
+        for g in range(Gp):
+            pk = packs[g] if g < len(packs) else packs[0]
+            is_live = g < len(packs)
+            slot0_pad = clusters[pk.members[0][0]][0]
+            gap_pad = slot0_pad
+            reads = []
+            for s, (ci, off, n) in enumerate(pk.members):
+                # align>1 gap lanes carry the PREVIOUS slot's seg id, so
+                # they must duplicate THAT slot's read (the edits union
+                # has no weight mask; a duplicate is a no-op there,
+                # weight 0 silences every other reduction)
+                reads += [gap_pad] * (off - len(reads))
+                reads.extend(clusters[ci])
+                gap_pad = clusters[ci][0]
+                if is_live:
+                    weights[g, off : off + n] = 1.0
+                    live[g, s] = True
+                info = infos[ci]
+                seed = clusters[ci][info.seed_idx]
+                tlens0[g, s] = info.tlen0
+                tmpl0[g, s, : len(seed)] = seed.seq
+            reads += [slot0_pad] * (N - len(reads))  # tail is seg id 0
+            b = batch_reads(reads, max_len=L, dtype=dtype)
+            seqs[g], match[g], mismatch[g] = b.seq, b.match, b.mismatch
+            ins[g], dels[g], lengths[g] = b.ins, b.dels, b.lengths
+            bandwidths[g] = [r.bandwidth for r in reads]
+            est_err[g] = [r.est_n_errors for r in reads]
+            seg_ids[g] = pk.seg_ids
+            # pad segment slots mirror slot 0 so their (frozen) loops
+            # trace over real-shaped data
+            for s in range(len(pk.members), S):
+                tlens0[g, s] = tlens0[g, 0]
+                tmpl0[g, s] = tmpl0[g, 0]
+        thresholds = np.array([
+            [poisson_cquantile(est_err[g, k], self.bandwidth_pvalue)
+             for k in range(N)] for g in range(Gp)
+        ])
+        return {
+            "plan": plan, "packs": list(packs), "seqs": seqs,
+            "match": match, "mismatch": mismatch, "ins": ins,
+            "dels": dels, "lengths": lengths, "weights": weights,
+            "bandwidths": bandwidths, "est_err": est_err,
+            "thresholds": thresholds, "tlens0": tlens0, "tmpl0": tmpl0,
+            "seg_ids": seg_ids, "live": live,
+        }
+
+    def run_seg(self, p: dict):
+        """Device side of one segment-packed chunk: same protocol as
+        ``run`` (adapt rounds block; the stage dispatch is async), with
+        per-LANE template lengths (each lane's band frame follows its
+        segment's template) and the segment stage program."""
+        import jax.numpy as jnp
+
+        from ..engine.device_loop import MAX_DRIFT
+
+        plan, packs = p["plan"], p["packs"]
+        _, _, Tmax, _ = plan.key
+        S = plan.sp
+        shard = self._shard
+        lengths, weights = p["lengths"], p["weights"]
+        bandwidths, tlens0 = p["bandwidths"], p["tlens0"]
+        seg_ids = p["seg_ids"]
+        # per-lane template length: each lane follows its own segment
+        tlen_lane = np.take_along_axis(tlens0, seg_ids, axis=1)
+
+        sq_d = shard(p["seqs"], None, None)
+        mt_d = shard(p["match"], None, None)
+        mm_d = shard(p["mismatch"], None, None)
+        gi_d = shard(p["ins"], None, None)
+        dl_d = shard(p["dels"], None, None)
+        ln_d = shard(lengths, None)
+        w_d = shard(weights, None)
+        sg_d = shard(seg_ids, None)
+        t0_d = shard(p["tmpl0"], None, None)
+        tl_d = jnp.asarray(tlens0)
+        lv_d = jnp.asarray(p["live"])
+
+        entry_bw = bandwidths.copy()
+        fixed = np.zeros_like(weights, bool)
+        fixed[weights == 0] = True
+        old_errors = np.full(lengths.shape, np.iinfo(np.int64).max)
+        for _ in range(MAX_BANDWIDTH_DOUBLINGS + 1):
+            K = _bucket(
+                int((2 * bandwidths + np.abs(lengths - tlen_lane)
+                     + 1).max()),
+                plan.band,
+            )
+            n_err = np.asarray(_seg_adapt_program(Tmax, K, S)(
+                sq_d, mt_d, mm_d, gi_d, dl_d, ln_d,
+                shard(bandwidths, None), w_d, sg_d, t0_d, tl_d,
+            )).astype(np.int64)
+            max_bw = np.minimum(
+                np.minimum(entry_bw << MAX_BANDWIDTH_DOUBLINGS,
+                           tlen_lane),
+                lengths,
+            )
+            grow = (~fixed) & (n_err > p["thresholds"]) & (
+                n_err < old_errors
+            ) & (bandwidths < max_bw)
+            fixed |= ~grow
+            if not grow.any():
+                break
+            old_errors = np.where(grow, n_err, old_errors)
+            bandwidths = np.where(
+                grow, np.minimum(bandwidths * 2, max_bw), bandwidths
+            )
+
+        K = _bucket(
+            int((2 * bandwidths + np.abs(lengths - tlen_lane)
+                 + 1).max()) + MAX_DRIFT,
+            plan.band,
+        )
+        step_state = (
+            (sq_d, mt_d, mm_d, gi_d, dl_d), ln_d,
+            shard(bandwidths, None), w_d, sg_d,
+        )
+        packed = _seg_stage_program(
+            Tmax, K, self.H, self.min_dist, self.use_edits, self.donate,
+            S,
+        )(t0_d, tl_d, lv_d, step_state)
+        return packed, plan, packs
+
+    def collect_seg(self, handle):
+        """Blocking fetch + unpack of a segment-packed chunk: one
+        ``(cluster index, SweepResult)`` per live segment."""
+        from ..engine.device_loop import unpack_stage_packed
+
+        packed_dev, plan, packs = handle
+        packed = np.asarray(packed_dev)
+        Tmax = plan.key[2]
+        out = []
+        for g, pk in enumerate(packs):
+            for s, (ci, _, _) in enumerate(pk.members):
+                tlen, total, n_rec, completed, _, _, _, tmpl = (
+                    unpack_stage_packed(packed[g, s], self.H, Tmax)
+                )
+                out.append((ci, SweepResult(
+                    consensus=tmpl[:tlen], score=total, n_iters=n_rec,
+                    converged=completed,
+                )))
+        return out
+
 
 def sweep_clusters_sharded(
     clusters: Sequence[Sequence[ReadScores]],
@@ -640,6 +994,8 @@ def sweep_clusters_sharded(
     do_alignment_proposals: bool = False,
     return_stats: bool = False,
     lane_target: int = LANE_TARGET,
+    segment_pack: Optional[bool] = None,
+    segment_align: int = 1,
 ):
     """One consensus per cluster, all clusters in one device program.
 
@@ -656,6 +1012,10 @@ def sweep_clusters_sharded(
     ``do_alignment_proposals`` enables
     the in-kernel alignment-edits candidate gate (the driver default),
     matching ``rifraf(..., do_alignment_proposals=True)``.
+    ``segment_pack``/``segment_align``: read-granularity packing of
+    small clusters into shared lane blocks (see plan_sweep; default
+    follows the ``RIFRAF_TPU_SEGMENT_PACK`` env gate). Results are
+    bit-identical either way (tests/test_lane_packing.py).
 
     Returns the per-cluster results IN INPUT ORDER; with
     ``return_stats`` also a SweepStats (per-bucket occupancy, padding
@@ -669,7 +1029,8 @@ def sweep_clusters_sharded(
         clusters, scheduler=scheduler, read_bucket=read_bucket,
         band_bucket=band_bucket, len_bucket=len_bucket,
         cluster_chunk=cluster_chunk, n_axis=n_axis, infos=infos,
-        lane_target=lane_target,
+        lane_target=lane_target, segment_pack=segment_pack,
+        segment_align=segment_align,
     )
     if G == 0:
         stats = SweepStats(0, 0, 0, 0, 0, 0.0, 0, 0.0, [])
@@ -691,21 +1052,27 @@ def sweep_clusters_sharded(
 
     def pack(task):
         bi, plan, idxs = task
-        return bi, executor.pack(plan, idxs, clusters, infos)
+        if isinstance(plan, SegmentBucketPlan):
+            return bi, True, executor.pack_seg(plan, idxs, clusters, infos)
+        return bi, False, executor.pack(plan, idxs, clusters, infos)
 
     def run(arg):
-        bi, packed = arg
+        bi, seg, packed = arg
         t0 = time.perf_counter()
-        handle = executor.run(packed)
+        handle = executor.run_seg(packed) if seg else executor.run(packed)
         bucket_seconds[bi] += time.perf_counter() - t0
-        return bi, handle
+        return bi, seg, handle
 
     def collect(arg):
-        bi, handle = arg
+        bi, seg, handle = arg
         t0 = time.perf_counter()
-        results = executor.collect(handle)
-        for ci, r in zip(handle[2], results):
-            out[ci] = r
+        if seg:
+            for ci, r in executor.collect_seg(handle):
+                out[ci] = r
+        else:
+            results = executor.collect(handle)
+            for ci, r in zip(handle[2], results):
+                out[ci] = r
         bucket_seconds[bi] += time.perf_counter() - t0
 
     pipeline_map(pack, run, collect, tasks)
@@ -721,25 +1088,41 @@ def sweep_clusters_sharded(
     cluster_lanes = 0
     slots_total = 0
     for bi, plan in enumerate(plans):
-        n_in = sum(len(ch) for ch in plan.chunks)
+        seg = isinstance(plan, SegmentBucketPlan)
+        if seg:
+            # chunks hold PackPlans; flatten to member cluster indices
+            idx_chunks = [
+                [ci for pk_ in ch for ci, _, _ in pk_.members]
+                for ch in plan.chunks
+            ]
+            n_slots_used = sum(len(ch) for ch in plan.chunks)
+        else:
+            idx_chunks = plan.chunks
+        n_in = sum(len(ch) for ch in idx_chunks)
         padded = len(plan.chunks) * plan.gp * plan.key[0] * plan.key[1]
-        useful = sum(infos[ci].useful for ch in plan.chunks for ci in ch)
+        useful = sum(infos[ci].useful for ch in idx_chunks for ci in ch)
         lane_lens = [
-            len(r) for ch in plan.chunks for ci in ch
+            len(r) for ch in idx_chunks for ci in ch
             for r in clusters[ci]
         ]
         pk = pack_lanes(lane_lens)
         slots = len(plan.chunks) * _lane_slots(plan.gp, plan.key[0])
         reads = sum(
-            infos[ci].n_reads for ch in plan.chunks for ci in ch
+            infos[ci].n_reads for ch in idx_chunks for ci in ch
         )
         reads_used += reads
-        cluster_lanes += n_in * plan.key[0]
+        # segment-packed buckets reserve lanes at READ granularity — a
+        # cluster occupies exactly its reads' lanes, not a whole Npad
+        # block, so cluster-lane accounting equals read accounting
+        cluster_lanes += reads if seg else n_in * plan.key[0]
         slots_total += slots
         buckets.append(BucketStats(
             key=plan.key, n_clusters=n_in, n_chunks=len(plan.chunks),
             gp=plan.gp,
-            occupancy=n_in / (len(plan.chunks) * plan.gp),
+            occupancy=(
+                (n_slots_used if seg else n_in)
+                / (len(plan.chunks) * plan.gp)
+            ),
             useful_cells=useful, padded_cells=padded,
             waste=1.0 - useful / padded,
             seconds=bucket_seconds[bi],

@@ -3,12 +3,20 @@
 Pure single-threaded logic (the server's batcher thread drives it with a
 monotonic clock), so the flush policy is testable with a fake clock and
 no JAX. Requests are grouped by their sweep-scheduler shape key
-(``parallel.sweep_sharded.bucket_key``); a bucket flushes when
+(``parallel.sweep_sharded.bucket_key``); with segment packing enabled
+(the default), small same-shape requests group by the SHAPE axes only
+(Lpad, Tmax, K0) — the worker packs them into shared lane blocks at
+read granularity, so Npad no longer separates them. A bucket flushes
+when
 
 - it reaches ``max_batch`` requests (occupancy flush),
-- its pending requests fill the 128-lane vector axis,
-  ``pending * Npad >= lane_target`` (lane-capacity flush — the launch's
-  read lanes are full, so waiting longer only adds lane tiles),
+- its pending requests fill the 128-lane vector axis (lane-capacity
+  flush — the launch's read lanes are full, so waiting longer only adds
+  lane tiles). The demand is the POST-PACKING lane count: pending reads
+  for a segment-packed bucket, ``pending * Npad`` for a whole-block
+  bucket. Counting ``pending * Npad`` for packed buckets would
+  over-flush — a 5-read request reserves 5 lanes in a shared block, not
+  its whole Npad=8 block,
 - its OLDEST request has waited ``max_wait_ms`` (latency flush), or
 - any member's deadline is within ``deadline_margin_ms`` (deadline-risk
   flush — dispatch now or miss it).
@@ -23,7 +31,26 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from ..parallel.sweep_sharded import SEG_TMAX_MAX, segment_pack_enabled
 from .request import Request, ServeConfig
+
+
+def resolve_segment_pack(config: ServeConfig) -> bool:
+    """Whether this server packs cross-request at read granularity:
+    the config field when set, else the ``RIFRAF_TPU_SEGMENT_PACK`` env
+    gate; always off without a lane target (nothing to fill)."""
+    sp = config.segment_pack
+    if sp is None:
+        sp = segment_pack_enabled()
+    return bool(sp) and config.lane_target > 0
+
+
+def segment_eligible(key, lane_target: int) -> bool:
+    """Whether a request of bucket ``key`` can share a lane block:
+    small enough to leave room (Npad below the lane target) and short
+    enough for the unblocked dense sweep (the same decline conditions
+    as plan_sweep)."""
+    return key[0] < lane_target and key[2] + 1 <= SEG_TMAX_MAX
 
 
 class MicroBatcher:
@@ -31,23 +58,45 @@ class MicroBatcher:
 
     def __init__(self, config: ServeConfig):
         self.config = config
-        self._pending: Dict[Tuple[int, int, int, int], List[Request]] = {}
+        self.segment_pack = resolve_segment_pack(config)
+        self._pending: Dict[Tuple, List[Request]] = {}
 
     def depth(self) -> int:
         return sum(len(v) for v in self._pending.values())
 
+    def _group_key(self, req: Request) -> Tuple:
+        if self.segment_pack and segment_eligible(
+            req.key, self.config.lane_target
+        ):
+            return ("seg",) + tuple(req.key[1:])
+        return ("blk",) + tuple(req.key)
+
+    def _lane_demand(self, key: Tuple, bucket: List[Request]) -> int:
+        """Post-packing lane demand of one pending bucket: reads for a
+        segment-packed group (requests share blocks at read
+        granularity; info-less requests fall back to their Npad), whole
+        Npad blocks otherwise."""
+        if key[0] == "seg":
+            return sum(
+                r.info.n_reads if r.info is not None else r.key[0]
+                for r in bucket
+            )
+        return sum(r.key[0] for r in bucket)
+
     def add(self, req: Request) -> Optional[List[Request]]:
         """Admit one request; returns a full bucket's flush (in arrival
         order) when this request filled it — by request count
-        (``max_batch``) or by lane capacity (``lane_target`` read lanes,
-        ``req.key[0]`` = Npad reads per cluster) — else None."""
-        bucket = self._pending.setdefault(req.key, [])
+        (``max_batch``) or by lane capacity (``lane_target`` read
+        lanes, post-packing demand) — else None."""
+        key = self._group_key(req)
+        bucket = self._pending.setdefault(key, [])
         bucket.append(req)
         lane_target = self.config.lane_target
         if len(bucket) >= self.config.max_batch or (
-            lane_target > 0 and len(bucket) * req.key[0] >= lane_target
+            lane_target > 0
+            and self._lane_demand(key, bucket) >= lane_target
         ):
-            return self._pending.pop(req.key)
+            return self._pending.pop(key)
         return None
 
     def due(self, now: float) -> List[List[Request]]:

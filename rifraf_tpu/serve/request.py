@@ -41,10 +41,18 @@ class ServeConfig:
     # cluster-axis padding ceiling of a micro-batch)
     max_batch: int = 16
     # ... or as soon as its pending requests fill the 128-lane vector
-    # axis (pending * Npad >= lane_target): a big-cluster bucket (say
-    # Npad=64) dispatches at 2 requests instead of waiting out
-    # max_wait_ms for 14 more that would only add lane tiles. 0 disables
+    # axis (post-packing lane demand >= lane_target): a big-cluster
+    # bucket (say Npad=64) dispatches at 2 requests instead of waiting
+    # out max_wait_ms for 14 more that would only add lane tiles. With
+    # segment packing the demand counts pending READS (requests share a
+    # lane block at read granularity); without it, whole Npad blocks.
+    # 0 disables
     lane_target: int = 128
+    # cross-request segment packing: small same-shape requests share one
+    # lane block at read granularity (parallel.sweep_sharded segment
+    # plans). None follows the RIFRAF_TPU_SEGMENT_PACK env gate; results
+    # are bit-identical either way (tests/test_lane_packing.py)
+    segment_pack: Optional[bool] = None
     # ... or when its oldest request has waited this long
     max_wait_ms: float = 20.0
     # ... or when any member's deadline is within this margin (the time

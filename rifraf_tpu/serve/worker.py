@@ -28,11 +28,14 @@ from ..parallel.cluster import PipelineJobError, pipeline_map
 from ..parallel.sweep_sharded import (
     BucketPlan,
     ChunkExecutor,
+    PackPlan,
+    SegmentBucketPlan,
     SweepResult,
     _lane_slots,
 )
 from ..utils.shapes import bucket as _bucket
-from ..utils.shapes import pow2_bucket
+from ..utils.shapes import pack_segments, pow2_bucket
+from .batcher import resolve_segment_pack, segment_eligible
 from .errors import DeadlineExceededError, ServeError
 from .request import Request, Response, ServeConfig
 from .stats import ServerStats
@@ -85,6 +88,7 @@ class Worker:
     def __init__(self, config: ServeConfig, stats: ServerStats):
         self.config = config
         self.stats = stats
+        self.segment_pack = resolve_segment_pack(config)
         self.executor = ChunkExecutor(
             mesh=config.mesh,
             max_iters=config.max_iters,
@@ -106,6 +110,50 @@ class Worker:
         return BucketPlan(key=key, band=self.config.band_bucket, gp=gp,
                           chunks=[list(range(n))])
 
+    def _seg_batch(self, live: List[Request]) -> bool:
+        """Whether a flushed micro-batch runs segment-packed: the
+        server packs cross-request, every member carries its cluster
+        info (the packer needs read counts and seed slots), and every
+        member individually qualifies (the batcher's grouping
+        guarantees this for its own flushes; drains can mix)."""
+        return self.segment_pack and all(
+            r.info is not None
+            and segment_eligible(r.key, self.config.lane_target)
+            for r in live
+        )
+
+    def seg_plan_for(self, requests: List[Request]):
+        """Segmented one-chunk plan for a micro-batch: first-fit the
+        requests' read counts into shared lane blocks
+        (utils.shapes.pack_segments); member indices index into the
+        flush's request list. The pack-count axis rounds to the next
+        power of two (and the mesh axis) like plan_for."""
+        cfg = self.config
+        pk = pack_segments(
+            [r.info.n_reads for r in requests], lanes=cfg.lane_target
+        )
+        npad = _bucket(pk.npad, cfg.read_bucket)
+        packs = [
+            PackPlan(
+                members=list(blk),
+                seg_ids=pk.seg_ids[b] + [0] * (npad - len(pk.seg_ids[b])),
+            )
+            for b, blk in enumerate(pk.blocks)
+        ]
+        mesh = cfg.mesh
+        n_axis = mesh.devices.size if mesh is not None else 1
+        gp = _bucket(pow2_bucket(len(packs)), max(n_axis, 1))
+        # segment-grouped requests share the shape axes exactly; maxima
+        # keep a mixed drain flush safe
+        shape = tuple(
+            max(r.key[i] for r in requests) for i in (1, 2, 3)
+        )
+        plan = SegmentBucketPlan(
+            key=(npad,) + shape, band=cfg.band_bucket, sp=pk.n_seg,
+            gp=gp, chunks=[packs],
+        )
+        return plan, packs
+
     def _pack(self, flush: Flush):
         if flush.kind != "batch":
             return flush, None
@@ -121,11 +169,35 @@ class Worker:
         if not live:
             return Flush("batch", []), None
         with self.stats.timers.time("serve_pack"):
-            plan = self.plan_for(live[0].key, len(live))
-            packed = self.executor.pack(
-                plan, range(len(live)), [r.cluster for r in live],
-                [r.info for r in live],
-            )
+            seg = self._seg_batch(live)
+            key = live[0].key
+            if seg:
+                plan, packs = self.seg_plan_for(live)
+                mesh = self.config.mesh
+                n_axis = mesh.devices.size if mesh is not None else 1
+                if (n_axis > 1 and len(packs) < n_axis
+                        and len(live) > len(packs)):
+                    # mesh decline (same rule as plan_sweep): the mesh
+                    # shards the pack axis, and packing this flush into
+                    # fewer packs than devices would serialize it while
+                    # one-request-per-slot shards evenly. A seg group
+                    # only shares the SHAPE axes, so the whole-block
+                    # fallback pads to the flush's per-axis maxima.
+                    seg = False
+                    key = tuple(
+                        max(r.key[i] for r in live) for i in range(4)
+                    )
+            if seg:
+                packed = self.executor.pack_seg(
+                    plan, packs, [r.cluster for r in live],
+                    [r.info for r in live],
+                )
+            else:
+                plan = self.plan_for(key, len(live))
+                packed = self.executor.pack(
+                    plan, range(len(live)), [r.cluster for r in live],
+                    [r.info for r in live],
+                )
         return Flush("batch", live), (plan, packed)
 
     def _run(self, arg):
@@ -135,16 +207,23 @@ class Worker:
         if staged is None:
             return flush, None
         plan, packed = staged
+        seg = isinstance(plan, SegmentBucketPlan)
         with self.stats.timers.time("serve_dispatch"):
-            handle = self.executor.run(packed)
-        N, L, _, _ = plan.key
+            handle = (self.executor.run_seg(packed) if seg
+                      else self.executor.run(packed))
+        N, L = plan.key[0], plan.key[1]
+        n_reads = sum(r.info.n_reads for r in flush.requests)
         self.stats.note_batch(
             n_real=len(flush.requests), gp=plan.gp,
             useful_cells=sum(r.info.useful for r in flush.requests),
             padded_cells=plan.gp * N * L,
-            useful_lanes=sum(r.info.n_reads for r in flush.requests),
+            useful_lanes=n_reads,
             lane_slots=_lane_slots(plan.gp, N),
-            cluster_lanes=len(flush.requests) * N,
+            # segment-packed requests reserve lanes at read granularity
+            # — a request's footprint is its reads, not a whole Npad
+            # block, so the corrected occupancy counts reads
+            cluster_lanes=(n_reads if seg
+                           else len(flush.requests) * N),
         )
         return flush, handle
 
@@ -155,6 +234,15 @@ class Worker:
         if flush.kind == "fallback":
             self._respond_ok(flush.requests[0], handle, "fallback")
             return 1
+        if isinstance(handle[1], SegmentBucketPlan):
+            with self.stats.timers.time("serve_fetch"):
+                pairs = self.executor.collect_seg(handle)
+            self.stats.note_model_bytes(_batch_model_bytes(
+                handle[1], [res for _, res in pairs]
+            ))
+            for ci, res in pairs:
+                self._respond_ok(flush.requests[ci], res, "batched")
+            return len(pairs)
         with self.stats.timers.time("serve_fetch"):
             results = self.executor.collect(handle)
         self.stats.note_model_bytes(_batch_model_bytes(handle[1], results))

@@ -175,6 +175,92 @@ def pack_lanes(lengths: Sequence[int], lanes: int = LANES) -> LanePacking:
     )
 
 
+class SegmentPacking(NamedTuple):
+    """Assignment of whole PROBLEMS (clusters) to shared lane blocks.
+
+    ``pack_lanes`` packs one problem's reads into tiles; this packs
+    many small problems into ONE ``[Npad]`` read block at READ
+    granularity — each problem occupies exactly its own read lanes
+    (optionally rounded to ``align``), identified by a per-lane
+    problem-id segment mask, instead of riding a whole
+    ``bucket(n_reads, read_bucket)`` block of its own. Every lane-axis
+    reduction downstream must then be segment-aware
+    (ops.fused.fused_step_segmented): per-segment masked sums walk the
+    lane axis in the same order with exact zeros elsewhere, so packed
+    results stay bit-identical to per-problem runs.
+
+    ``blocks[b]`` lists (problem index, lane offset, n_lanes) for block
+    ``b``; ``seg_ids[b]`` is the per-lane problem-SLOT id of block b
+    (slot s = s-th member of the block, NOT the global problem index;
+    pad lanes hold slot 0 and must carry weight 0)."""
+
+    blocks: List[List[tuple]]  # per block: (problem, offset, n_lanes)
+    seg_ids: List[List[int]]  # per block: [npad] per-lane slot ids
+    npad: int  # shared lane-block height (all blocks one shape)
+    n_seg: int  # max problems per block (the static segment axis)
+    occupancy: float  # useful lanes / (n_blocks * npad)
+
+
+def pack_segments(
+    counts: Sequence[int],
+    lanes: int = LANES,
+    align: int = 1,
+) -> SegmentPacking:
+    """First-fit-decreasing packing of problem read counts into shared
+    ``lanes``-high blocks. ``align`` rounds each problem's lane
+    footprint (use the per-problem read grid on backends whose lane
+    reductions are tree-shaped rather than sequential — a segment whose
+    lanes start at a multiple of its own padded width reduces under the
+    same tree shape as its per-problem block; the default 1 is exact
+    for order-preserving reductions, which is what the XLA fused step
+    compiles to on current backends). Problems wider than ``lanes``
+    are rejected — the caller routes those through whole-block
+    execution (the packer declines)."""
+    counts = [int(c) for c in counts]
+    if any(c <= 0 for c in counts):
+        raise ValueError("pack_segments needs positive read counts")
+    widths = [bucket(c, align) if align > 1 else c for c in counts]
+    if any(w > lanes for w in widths):
+        raise ValueError("problem wider than one lane block")
+    order = sorted(range(len(counts)), key=lambda i: (-widths[i], i))
+    blocks: List[List[tuple]] = []
+    used: List[int] = []
+    for i in order:
+        w = widths[i]
+        for b, u in enumerate(used):
+            if u + w <= lanes:
+                blocks[b].append((i, u, counts[i]))
+                used[b] = u + w
+                break
+        else:
+            blocks.append([(i, 0, counts[i])])
+            used.append(w)
+    if not blocks:
+        return SegmentPacking([], [], 0, 0, 1.0)
+    # keep input order within each block (the sweep's documented
+    # intra-bucket order invariant) and recompute contiguous offsets
+    npad = lanes if len(blocks) > 1 else bucket(max(used), align)
+    seg_ids = []
+    for b, members in enumerate(blocks):
+        members.sort(key=lambda t: t[0])
+        off = 0
+        ids = []
+        for s, (i, _, n) in enumerate(members):
+            members[s] = (i, off, n)
+            ids.extend([s] * widths[i])
+            off += widths[i]
+        ids.extend([0] * (npad - len(ids)))
+        seg_ids.append(ids)
+    useful = sum(counts)
+    return SegmentPacking(
+        blocks=blocks,
+        seg_ids=seg_ids,
+        npad=npad,
+        n_seg=max(len(m) for m in blocks),
+        occupancy=useful / (len(blocks) * npad) if blocks else 1.0,
+    )
+
+
 def bucket(n: int, b: int) -> int:
     """Round ``n`` up to the next multiple of ``b``."""
     return ((n + b - 1) // b) * b

@@ -17,22 +17,31 @@ Two layers:
   geometry (the sweep module's core invariant).
 """
 
+from types import SimpleNamespace
+
 import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
+jnp = pytest.importorskip("jax.numpy")
 
 from rifraf_tpu.models.errormodel import ErrorModel
-from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
 from rifraf_tpu.parallel.sweep_sharded import (
+    SEG_TMAX_MAX,
+    BucketPlan,
+    SegmentBucketPlan,
+    _ClusterInfo,
     _lane_slots,
+    plan_sweep,
     sweep_clusters_sharded,
 )
-from rifraf_tpu.serve.batcher import MicroBatcher
+from rifraf_tpu.serve.batcher import MicroBatcher, segment_eligible
 from rifraf_tpu.serve.request import Request, ServeConfig
 from rifraf_tpu.serve.stats import ServerStats
 from rifraf_tpu.sim.sample import sample_sequences
 from rifraf_tpu.utils.phred import phred_to_log_p
+from rifraf_tpu.utils.shapes import pack_segments
 
 SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
 
@@ -66,6 +75,283 @@ def _mixed_clusters(seed=0):
 def _req(rid, key):
     return Request(id=rid, cluster=[], info=None, key=key, t_submit=0.0,
                    deadline=None)
+
+
+def _sreq(rid, key, n_reads):
+    """A request that carries its read count (the segment packer's
+    input); the batcher only touches ``info.n_reads``."""
+    return Request(id=rid, cluster=[], info=SimpleNamespace(n_reads=n_reads),
+                   key=key, t_submit=0.0, deadline=None)
+
+
+def _info(n_reads, max_len=50, tlen0=48, entry_k=16):
+    return _ClusterInfo(n_reads=n_reads, max_len=max_len, seed_idx=0,
+                        tlen0=tlen0, entry_k=entry_k,
+                        useful=n_reads * max_len)
+
+
+# ---------------------------------------- fast: segment packer properties
+
+
+def test_seg_tmax_matches_dense_block_threshold():
+    """The packer's template ceiling must track the unblocked dense
+    sweep's: fused_step_segmented declines blocked-dense templates
+    (whose internal reductions are not segment-aware), so admitting a
+    longer template here would fail at trace time instead of routing
+    whole-block."""
+    from rifraf_tpu.ops.fused import DENSE_BLOCK_THRESHOLD
+
+    assert SEG_TMAX_MAX == DENSE_BLOCK_THRESHOLD
+
+
+def test_pack_segments_first_fit_properties():
+    counts = [5, 11, 3, 120, 7]
+    pk = pack_segments(counts, lanes=128)
+    # every problem lands exactly once, with its true read count
+    placed = sorted(
+        (i, n) for blk in pk.blocks for i, _, n in blk
+    )
+    assert placed == sorted(enumerate(counts))
+    assert pk.npad <= 128 and pk.n_seg == max(len(b) for b in pk.blocks)
+    assert pk.occupancy == pytest.approx(
+        sum(counts) / (len(pk.blocks) * pk.npad)
+    )
+    for b, blk in enumerate(pk.blocks):
+        # input order within a block, contiguous offsets, and a seg-id
+        # mask that tags exactly each member's lanes with its slot
+        assert [i for i, _, _ in blk] == sorted(i for i, _, _ in blk)
+        off = 0
+        for s, (i, o, n) in enumerate(blk):
+            assert o == off
+            assert pk.seg_ids[b][o : o + n] == [s] * n
+            off += n
+        assert off <= 128
+        assert pk.seg_ids[b][off:] == [0] * (pk.npad - off)
+
+
+def test_pack_segments_single_block_npad():
+    """One block packs tight: npad is the used width (align grid), not
+    a full lane tile."""
+    pk = pack_segments([5, 3], lanes=128)
+    assert len(pk.blocks) == 1 and pk.npad == 8
+    assert pk.seg_ids[0] == [0] * 5 + [1] * 3
+    assert pk.blocks[0] == [(0, 0, 5), (1, 5, 3)]
+
+
+def test_pack_segments_align():
+    """align rounds each problem's lane footprint; gap lanes between a
+    member's reads and the next offset keep the member's slot id."""
+    pk = pack_segments([5, 3], lanes=128, align=8)
+    assert len(pk.blocks) == 1 and pk.npad == 16
+    assert pk.blocks[0] == [(0, 0, 5), (1, 8, 3)]
+    assert pk.seg_ids[0] == [0] * 8 + [1] * 8
+
+
+def test_pack_segments_declines():
+    with pytest.raises(ValueError):
+        pack_segments([129], lanes=128)  # wider than one block
+    with pytest.raises(ValueError):
+        pack_segments([4, 0])  # empty problem
+
+
+# --------------------------- fast: segment-masked reduction bit-identity
+
+
+def test_segment_reduce_masking_is_exact():
+    """The structural property packed bit-identity rests on: a
+    per-segment masked sum equals (bit for bit) the SAME-width
+    reduction with foreign lanes zero-weighted — masking happens before
+    multiplying, zeros are exact — and is therefore completely
+    independent of foreign-lane content, NaN/-inf included. (Packed vs
+    PER-PROBLEM bit-identity is asserted end-to-end by the slow sweep/
+    serve suites at the pipeline's real reduction shapes; a bare
+    narrower reduce may round differently, which is why the executed
+    paths compare like-for-like.) Mixed magnitudes spanning 16 orders
+    stress float associativity."""
+    from rifraf_tpu.ops.fused import (
+        masked_weighted_sum,
+        segment_masked_sum,
+        segment_masked_sum_lanes,
+        segment_weights,
+    )
+
+    rng = np.random.default_rng(0)
+    counts = [5, 11, 3]
+    npad = 24  # 19 real lanes + 5 pad lanes (seg id 0, weight 0)
+    seg_ids = np.zeros(npad, np.int32)
+    w = np.zeros(npad, np.float32)
+    off = 0
+    for s, n in enumerate(counts):
+        seg_ids[off : off + n] = s
+        w[off : off + n] = rng.uniform(0.5, 2.0, n).astype(np.float32)
+        off += n
+    # magnitudes spanning 16 orders stress float associativity
+    x = (rng.uniform(-1.0, 1.0, (7, npad))
+         * 10.0 ** rng.integers(-8, 9, (7, npad))).astype(np.float32)
+
+    seg_w = segment_weights(jnp.asarray(seg_ids), jnp.asarray(w), 3)
+    got_reads = np.asarray(segment_masked_sum(seg_w, jnp.asarray(x.T)))
+    got_lanes = np.asarray(segment_masked_sum_lanes(seg_w, jnp.asarray(x)))
+    for s in range(3):
+        wz = jnp.asarray(np.where(seg_ids == s, w, 0.0).astype(np.float32))
+        want = np.asarray(masked_weighted_sum(wz, jnp.asarray(x.T)))
+        np.testing.assert_array_equal(got_reads[s], want)
+        # the lane-LAST variant matches the same-orientation reduce
+        # (axis order changes the lowering, so each epilogue compares
+        # against its own orientation)
+        want_l = np.asarray(jnp.sum(
+            jnp.where(wz > 0, jnp.asarray(x), np.float32(0.0)) * wz,
+            axis=-1,
+        ))
+        np.testing.assert_array_equal(got_lanes[s], want_l)
+
+    # foreign-lane independence: poison every lane OUTSIDE segment 1
+    # with NaN/-inf/huge garbage — segment 1's results must not move a
+    # bit (zero-weight lanes are masked BEFORE the multiply)
+    x_poison = x.copy()
+    x_poison[:, seg_ids != 1] = np.float32(np.nan)
+    x_poison[0, 0] = np.float32(-np.inf)
+    x_poison[1, 20] = np.float32(1e38)
+    got_p = np.asarray(
+        segment_masked_sum(seg_w, jnp.asarray(x_poison.T))
+    )
+    np.testing.assert_array_equal(got_p[1], got_reads[1])
+
+
+def test_segment_union_pad_lanes_are_noops():
+    """Pad/gap lanes duplicate a read of their assigned slot, so the
+    per-segment edits union (which has no weight mask) is unchanged by
+    them — and foreign lanes never leak into a segment's union."""
+    from rifraf_tpu.ops.fused import segment_union_max_lanes
+
+    seg_ids = jnp.asarray([0, 0, 1, 1, 1, 0, 0, 0], jnp.int32)
+    x = np.zeros((4, 8), np.float32)
+    x[:, 0] = [1, 0, 1, 0]  # segment 0's real reads
+    x[:, 1] = [0, 1, 0, 0]
+    x[:, 2:5] = np.array([[0, 0, 0, 1]]).T  # segment 1
+    x[:, 5:] = x[:, :1]  # pad lanes: duplicates of seg-0 read 0
+    um = np.asarray(segment_union_max_lanes(seg_ids, jnp.asarray(x), 2))
+    np.testing.assert_array_equal(um[0], [1, 1, 1, 0])
+    np.testing.assert_array_equal(um[1], [0, 0, 0, 1])
+
+
+# ----------------------------- fast: batcher read-granularity grouping
+
+
+def test_batcher_segment_group_flushes_on_reads():
+    """Segment-packed buckets flush on pending READS, not pending
+    blocks: 25 five-read requests occupy 125 lanes of a shared block
+    (< 128), where whole-Npad counting (8 lanes each) would have
+    over-flushed at 16."""
+    b = MicroBatcher(ServeConfig(max_batch=64, lane_target=128))
+    k8 = (8, 64, 64, 16)
+    for i in range(25):
+        assert b.add(_sreq(f"r{i}", k8, 5)) is None
+    full = b.add(_sreq("r25", k8, 5))  # 130 reads >= 128
+    assert full is not None and len(full) == 26
+
+
+def test_batcher_segment_groups_merge_npad_buckets():
+    """Segment grouping keys on the SHAPE axes only: requests whose
+    Npad differs (5 vs 11 reads) share one pending bucket and pack into
+    the same lane blocks."""
+    b = MicroBatcher(ServeConfig(max_batch=64, lane_target=128))
+    shape = (64, 64, 16)
+    for i in range(7):
+        assert b.add(_sreq(f"a{i}", (8,) + shape, 5)) is None
+        assert b.add(_sreq(f"b{i}", (16,) + shape, 11)) is None
+    assert b.add(_sreq("a7", (8,) + shape, 5)) is None  # 117 reads
+    assert b.depth() == 15  # ONE merged bucket across both Npad keys
+    full = b.add(_sreq("b7", (16,) + shape, 11))  # 128 reads: flush
+    assert full is not None and len(full) == 16
+
+
+def test_batcher_segment_ineligible_routes_whole_block():
+    """Requests the packer declines (Npad fills a tile alone, or a
+    blocked-dense template) group under the whole-block key."""
+    assert not segment_eligible((128, 256, 256, 32), 128)
+    assert not segment_eligible((8, 64, SEG_TMAX_MAX + 64, 16), 128)
+    assert segment_eligible((8, 64, 64, 16), 128)
+    b = MicroBatcher(ServeConfig(max_batch=64, lane_target=128))
+    # a lone full-tile request flushes immediately on lane capacity
+    assert b.add(_sreq("big", (128, 256, 256, 32), 100)) is not None
+
+
+def test_batcher_segment_pack_config_off():
+    """segment_pack=False restores whole-block grouping: 16 Npad=8
+    requests fill 128 lanes of whole blocks regardless of read counts."""
+    b = MicroBatcher(ServeConfig(max_batch=64, lane_target=128,
+                                 segment_pack=False))
+    k8 = (8, 64, 64, 16)
+    for i in range(15):
+        assert b.add(_sreq(f"r{i}", k8, 5)) is None
+    assert b.add(_sreq("r15", k8, 5)) is not None  # 16 * 8 == 128
+
+
+# ------------------------------------ fast: planner segment-group rules
+
+
+def test_plan_sweep_segments_small_clusters():
+    """Small same-shape clusters plan as ONE segment-packed bucket (a
+    5-read and an 11-read cluster share 16 lanes instead of 8+16);
+    tile-filling clusters stay on the whole-block path."""
+    infos = [_info(5), _info(11), _info(3), _info(128)]
+    plans = plan_sweep([], infos=infos, lane_target=128,
+                       segment_pack=True)
+    segs = [p for p in plans if isinstance(p, SegmentBucketPlan)]
+    blks = [p for p in plans if isinstance(p, BucketPlan)]
+    assert len(segs) == 1 and len(blks) == 1
+    assert blks[0].chunks == [[3]]  # the 128-read cluster
+    (seg,) = segs
+    assert seg.key[0] == 24  # 19 lanes -> read grid 8
+    assert seg.sp == 3 and len(seg.chunks) == 1
+    (packs,) = seg.chunks
+    assert sorted(i for pk in packs for i, _, _ in pk.members) == [0, 1, 2]
+
+
+def test_plan_sweep_segment_mesh_decline():
+    """A mesh larger than the pack count would serialize the (sharded)
+    pack axis, so the planner declines packing and shards one cluster
+    per device instead; a mesh the packs can fill stays packed."""
+    small = [_info(8) for _ in range(8)]
+    # 8 clusters x 8 reads -> one 64-lane pack: packed on 1 device,
+    # declined (cluster-per-slot whole block) on an 8-device mesh
+    (p1,) = plan_sweep([], infos=small, lane_target=128,
+                       segment_pack=True, n_axis=1)
+    assert isinstance(p1, SegmentBucketPlan)
+    (p8,) = plan_sweep([], infos=small, lane_target=128,
+                       segment_pack=True, n_axis=8)
+    assert isinstance(p8, BucketPlan)
+    assert p8.gp == 8 and len(p8.chunks) == 1
+    # 16 x 60-read clusters pack two per block -> 8 packs fill the
+    # 8-device mesh: packing survives
+    wide = [_info(60, max_len=60) for _ in range(16)]
+    (pw,) = plan_sweep([], infos=wide, lane_target=128,
+                       segment_pack=True, n_axis=8)
+    assert isinstance(pw, SegmentBucketPlan)
+    assert sum(len(c) for c in pw.chunks) == 8
+
+
+def test_plan_sweep_segment_env_opt_out(monkeypatch):
+    infos = [_info(5), _info(11), _info(3)]
+    monkeypatch.setenv("RIFRAF_TPU_SEGMENT_PACK", "0")
+    plans = plan_sweep([], infos=infos, lane_target=128)
+    assert all(isinstance(p, BucketPlan) for p in plans)
+    # the explicit argument overrides the env gate
+    plans = plan_sweep([], infos=infos, lane_target=128,
+                       segment_pack=True)
+    assert any(isinstance(p, SegmentBucketPlan) for p in plans)
+
+
+def test_mega_declines_segment_packed_launch():
+    """The megakernel fills one template per launch; multi-segment
+    packed blocks must route to the XLA segmented step."""
+    from rifraf_tpu.ops import fused_pallas
+
+    ok, reason = fused_pallas.mega_segment_eligible(1)
+    assert ok
+    ok, reason = fused_pallas.mega_segment_eligible(2)
+    assert not ok and "segment" in reason
 
 
 def test_batcher_lane_capacity_flush():
@@ -143,10 +429,177 @@ def test_packed_sweep_matches_per_problem(proposals):
         assert a.score == b.score, g
         assert a.n_iters == b.n_iters, g
         assert a.converged == b.converged, g
-    # packing is real: fewer launches, better lane fill at both levels
+    # packing is real: fewer launches, better read-granularity lane
+    # fill. (Block-granularity lane_occupancy is NOT comparable across
+    # the two runs once segment packing reserves lanes per read instead
+    # of per whole Npad block — the packed numerator shrinks to the
+    # read count while the solo one keeps counting reserved blocks.)
     assert pstats.n_chunks < sstats.n_chunks
-    assert pstats.lane_occupancy > sstats.lane_occupancy
     assert pstats.lane_occupancy_reads > sstats.lane_occupancy_reads
+    # reservation can only be at least as coarse as the reads it holds
+    assert pstats.lane_occupancy >= pstats.lane_occupancy_reads
     for bs in pstats.buckets:
         assert bs.lane_slots == bs.n_chunks * _lane_slots(bs.gp, bs.key[0])
         assert 0.0 < bs.lane_slot_occupancy <= 1.0
+
+
+# -------------------- slow: segmented fused step vs per-problem oracle
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("want_stats", [False, True])
+def test_fused_step_segmented_matches_per_problem(want_stats):
+    """Kernel-level identity: three problems with distinct band
+    geometries (bandwidths 4/9/30, different template lengths) packed
+    into one lane block through ``fused_step_segmented`` produce the
+    SAME bits — per-segment totals, per-lane scores, dense tables, and
+    (stats on) traceback error counts + edits unions — as three
+    independent ``fused_step_full`` launches at the same (K, Tmax)."""
+    from rifraf_tpu.ops import align_jax
+    from rifraf_tpu.ops.fused import (
+        fused_step_full,
+        fused_step_segmented,
+        pack_layout,
+    )
+
+    clusters = _mixed_clusters(seed=5)[:3]
+    counts = [len(c) for c in clusters]
+    tlens = [len(c[0]) for c in clusters]
+    Tmax = max(tlens) + 8
+    tmpl = np.zeros((3, Tmax), np.int8)
+    for s, c in enumerate(clusters):
+        tmpl[s, : tlens[s]] = c[0].seq
+    L = max(len(r) for c in clusters for r in c) + 4
+
+    npad = 16  # 12 real lanes + tail pads (seg id 0, weight 0)
+    reads, seg_ids, bws = [], [], []
+    for s, c in enumerate(clusters):
+        reads.extend(c)
+        seg_ids.extend([s] * len(c))
+        bws.extend(r.bandwidth for r in c)
+    pad = npad - len(reads)
+    reads += [clusters[0][0]] * pad  # duplicates of slot 0's first read
+    seg_ids += [0] * pad
+    bws += [clusters[0][0].bandwidth] * pad
+    weights = np.asarray([1.0] * (npad - pad) + [0.0] * pad, np.float32)
+    b = batch_reads(reads, max_len=L, dtype=np.float32)
+    lane_tlens = np.asarray(tlens, np.int32)[np.asarray(seg_ids)]
+    geom_all = align_jax.BandGeometry.make(
+        jnp.asarray(b.lengths), jnp.asarray(lane_tlens),
+        jnp.asarray(bws, np.int32),
+    )
+    K = int(np.asarray(geom_all.nd).max() + np.asarray(geom_all.offset).max())
+    K = ((K + 7) // 8) * 8
+
+    seg = fused_step_segmented(
+        jnp.asarray(tmpl), jnp.asarray(tlens, np.int32),
+        jnp.asarray(seg_ids, np.int32), jnp.asarray(b.seq),
+        jnp.asarray(b.match), jnp.asarray(b.mismatch), jnp.asarray(b.ins),
+        jnp.asarray(b.dels), jnp.asarray(b.lengths),
+        jnp.asarray(bws, np.int32), jnp.asarray(weights), K, 3,
+        want_stats=want_stats,
+    )
+
+    T1 = Tmax + 1
+    off = 0
+    for s, c in enumerate(clusters):
+        n, tlen = counts[s], tlens[s]
+        bi = batch_reads(list(c), max_len=L, dtype=np.float32)
+        bw_i = jnp.asarray([r.bandwidth for r in c], np.int32)
+        geom = align_jax.BandGeometry.make(
+            jnp.asarray(bi.lengths), jnp.full((n,), tlen, jnp.int32), bw_i
+        )
+        _, _, _, packed = fused_step_full(
+            jnp.asarray(tmpl[s]), jnp.asarray(bi.seq),
+            jnp.asarray(bi.match), jnp.asarray(bi.mismatch),
+            jnp.asarray(bi.ins), jnp.asarray(bi.dels), geom,
+            jnp.ones((n,), jnp.float32), K, want_stats=want_stats,
+        )
+        packed = np.asarray(packed)
+        lay = pack_layout(n, T1, want_stats)
+        np.testing.assert_array_equal(
+            np.asarray(seg["total"])[s], packed[slice(*lay["total"])][0],
+            err_msg=f"total s={s}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(seg["scores"])[off : off + n],
+            packed[slice(*lay["scores"])], err_msg=f"scores s={s}",
+        )
+        for name, hi, shp in (("sub", tlen, (T1, 4)),
+                              ("ins", tlen + 1, (T1, 4)),
+                              ("del", tlen, (T1,))):
+            want = packed[slice(*lay[name])].reshape(shp)[:hi]
+            np.testing.assert_array_equal(
+                np.asarray(seg[name])[s][:hi], want, err_msg=f"{name} s={s}"
+            )
+        if want_stats:
+            np.testing.assert_array_equal(
+                np.asarray(seg["n_errors"])[off : off + n],
+                packed[slice(*lay["n_errors"])], err_msg=f"n_errors s={s}",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(seg["edits"])[s][: tlen + 1],
+                packed[slice(*lay["edits"])].reshape(T1, 9)[: tlen + 1],
+                err_msg=f"edits s={s}",
+            )
+        off += n
+
+
+@pytest.mark.slow
+def test_stats_panel_layouts_bit_identical(monkeypatch):
+    """The two stats panel layouts — the int8 move-band Pallas panel
+    sweep (``int8_moves_ok``) and the int32/XLA moves-band scan the env
+    opt-out pins — must produce bit-identical traceback error counts
+    and edits unions on the same panel-fused inputs, so segment-packed
+    accounting stays layout-independent."""
+    from rifraf_tpu.models.errormodel import ErrorModel, Scores
+    from rifraf_tpu.ops import align_jax, dense_pallas, fill_pallas
+    from rifraf_tpu.ops import stats_pallas
+
+    scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+    rng = np.random.default_rng(17)
+    tlen = 40
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(3):
+        slen = int(rng.integers(tlen - 5, tlen + 6))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -1.0, size=slen)
+        reads.append(make_read_scores(s, log_p, 4, scores))
+    batch = batch_reads(reads, dtype=np.float32)
+    geom = align_jax.batch_geometry(batch, tlen)
+    K = fill_pallas.uniform_band_height(
+        np.asarray(geom.offset), np.asarray(geom.nd)
+    )
+    C = 8
+    assert stats_pallas.int8_moves_ok(K, C)  # uniform K is 8-aligned
+    Tmax = ((tlen + 63) // 64) * 64
+    T1p = Tmax + 64
+    tpl = np.zeros(Tmax, np.int8)
+    tpl[:tlen] = template
+    Npad = ((batch.n_reads + 127) // 128) * 128
+    bufs = fill_pallas.build_fill_buffers(
+        jnp.asarray(batch.seq), jnp.asarray(batch.match),
+        jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+        jnp.asarray(batch.dels), jnp.asarray(batch.lengths), Npad,
+    )
+    args = (jnp.asarray(tpl), jnp.int32(tlen), bufs, geom,
+            jnp.ones(batch.n_reads, np.float32), K, T1p, C)
+
+    int8_out = dense_pallas.fused_tables_pallas_panels(
+        *args, panel_cols=16, want_stats=True, interpret=True,
+    )
+    monkeypatch.setenv("RIFRAF_TPU_STATS_IMPL", "xla")
+    assert not stats_pallas.use_pallas_stats()
+    xla_out = dense_pallas.fused_tables_pallas_panels(
+        *args, panel_cols=16, want_stats=True, interpret=True,
+    )
+    N = batch.n_reads
+    np.testing.assert_array_equal(
+        np.asarray(int8_out["n_errors"])[:N],
+        np.asarray(xla_out["n_errors"])[:N],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(int8_out["edits"])[: tlen + 1],
+        np.asarray(xla_out["edits"])[: tlen + 1],
+    )

@@ -115,8 +115,11 @@ def _fake_request(rid, key, t_submit, deadline=None):
 
 
 def test_microbatcher_flush_policy_fake_clock():
+    # segment packing would group ka/kb by their shared shape axes;
+    # this test pins the classic per-key policy (the timing logic is
+    # identical either way)
     cfg = serve.ServeConfig(max_batch=3, max_wait_ms=20.0,
-                            deadline_margin_ms=50.0)
+                            deadline_margin_ms=50.0, segment_pack=False)
     b = MicroBatcher(cfg)
     ka, kb = (8, 64, 64, 16), (16, 64, 64, 16)
 

@@ -11,7 +11,19 @@ import numpy as np
 import pytest
 
 from rifraf_tpu.parallel.cluster import pipeline_map
-from rifraf_tpu.parallel.sweep_sharded import plan_cells, plan_sweep
+from rifraf_tpu.parallel.sweep_sharded import (
+    SegmentBucketPlan,
+    plan_cells,
+    plan_sweep,
+)
+
+
+def _chunk_members(p, ch):
+    """Cluster indices of one chunk: plain for a BucketPlan, unpacked
+    from the PackPlans of a segment-packed chunk."""
+    if isinstance(p, SegmentBucketPlan):
+        return [m[0] for pk in ch for m in pk.members]
+    return list(ch)
 
 
 class _Read:
@@ -41,12 +53,14 @@ HET = (
 
 def test_plan_partitions_inputs_in_order():
     """Every input cluster lands in exactly one chunk, and chunks
-    preserve input order within a bucket."""
+    preserve input order within a bucket — whether the chunk holds
+    whole-block members or segment-packed PackPlans."""
     plans = plan_sweep(HET)
-    seen = [i for p in plans for ch in p.chunks for i in ch]
+    seen = [i for p in plans for ch in p.chunks
+            for i in _chunk_members(p, ch)]
     assert sorted(seen) == list(range(len(HET)))
     for p in plans:
-        flat = [i for ch in p.chunks for i in ch]
+        flat = [i for ch in p.chunks for i in _chunk_members(p, ch)]
         assert flat == sorted(flat)
 
 
@@ -57,7 +71,7 @@ def test_plan_keys_on_grid_and_cover_members():
         assert n_pad % 8 == 0 and l_pad % 64 == 0 and t_max % 64 == 0
         assert k0 % 16 == 0
         for ch in p.chunks:
-            for i in ch:
+            for i in _chunk_members(p, ch):
                 c = HET[i]
                 assert len(c) <= n_pad
                 assert max(len(r) for r in c) <= l_pad
@@ -124,9 +138,15 @@ def test_lane_target_fills_lane_tiles():
     """The lane-packing floor: with a small cluster_chunk, a bucket of
     small clusters (Npad=8) still packs ceil(128/8)=16 clusters per
     chunk (bounded by member count), so each launch fills the 128-lane
-    axis instead of dispatching a quarter-full tile."""
+    axis instead of dispatching a quarter-full tile.
+
+    segment_pack=False pins the WHOLE-BLOCK floor this test documents:
+    with the default segment packing these clusters would instead share
+    read-granularity lane blocks (tests/test_lane_packing.py covers
+    that path)."""
     many = [_cluster(5, 50) for _ in range(40)]  # one bucket, Npad=8
-    plans = plan_sweep(many, cluster_chunk=2, n_axis=1, lane_target=128)
+    plans = plan_sweep(many, cluster_chunk=2, n_axis=1, lane_target=128,
+                       segment_pack=False)
     assert len(plans) == 1
     p = plans[0]
     assert p.key[0] == 8
@@ -134,7 +154,8 @@ def test_lane_target_fills_lane_tiles():
     assert p.gp * p.key[0] >= 128
     # bounded by membership: 3 members can't be packed to 16
     few = [_cluster(5, 50) for _ in range(3)]
-    (pf,) = plan_sweep(few, cluster_chunk=2, n_axis=1, lane_target=128)
+    (pf,) = plan_sweep(few, cluster_chunk=2, n_axis=1, lane_target=128,
+                       segment_pack=False)
     assert pf.gp == 3
 
 
@@ -156,11 +177,15 @@ def test_lane_target_coalesces_underfilled_buckets():
     """Buckets whose whole membership cannot fill one 128-lane tile are
     merged into coarser-grid neighbours (and finally absorbed per
     read-count class), so a ragtag of near-miss shapes shares fuller
-    launches instead of each paying a mostly-empty tile + a compile."""
+    launches instead of each paying a mostly-empty tile + a compile.
+
+    segment_pack=False pins the WHOLE-BLOCK coalescer this test
+    documents — the default segment packer supersedes it for clusters
+    this small (tests/test_lane_packing.py covers that path)."""
     # 8 tiny clusters spread over 8 distinct fine length buckets
     ragtag = [_cluster(4, 40 + 70 * k) for k in range(8)]
     fine = plan_sweep(ragtag, lane_target=0)
-    packed = plan_sweep(ragtag, lane_target=128)
+    packed = plan_sweep(ragtag, lane_target=128, segment_pack=False)
     assert len(fine) == 8
     assert len(packed) < len(fine)
     # coverage: every cluster in exactly one chunk, members in input
@@ -181,7 +206,7 @@ def test_lane_target_coalesces_underfilled_buckets():
     mixed = [_cluster(4, 40 + 30 * k) for k in range(4)] + [
         _cluster(12, 40 + 30 * k) for k in range(4)
     ]
-    for p in plan_sweep(mixed, lane_target=128):
+    for p in plan_sweep(mixed, lane_target=128, segment_pack=False):
         npads = {8 if len(mixed[i]) <= 8 else 16
                  for ch in p.chunks for i in ch}
         assert npads == {p.key[0]}

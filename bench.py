@@ -46,7 +46,10 @@ Other modes (results appended to BASELINE.md, not the driver JSON):
                one-request-per-dispatch server (the >=2x claim), a
                Poisson-arrivals pass for latency percentiles, and the
                offline sharded sweep on the identical clusters as the
-               throughput ceiling / bit-identity reference (--serve-n
+               throughput ceiling / bit-identity reference, plus a
+               chaos pass: Poisson load under injected faults (ladder
+               retries, one worker-killing crash) reporting
+               availability, p99, and restart counts (--serve-n
                overrides the request count for smoke runs; slow-only
                in CI)
   --quick      headline only (skip the north-star / ref-default extras)
@@ -638,6 +641,56 @@ def _serve_mode():
         np.array_equal(r.consensus, o.consensus) and r.score == o.score
         for r, o in zip(responses, offline)
     )
+
+    # 4. chaos: Poisson arrivals under injected faults — transient
+    # dispatch errors (the degradation ladder re-runs those
+    # micro-batches one rung down), slowed fetches, and one
+    # worker-killing crash mid-run (the supervisor restarts the thread
+    # and requeues its in-flight requests). Availability is the
+    # fraction of requests answered ok; every future must resolve
+    # typed — the acceptance bar is availability >= 0.99 with at least
+    # one worker restart.
+    n_chaos = min(n_requests, 200)
+    chaos_clusters = clusters[:n_chaos]
+    faults = ("dispatch:error:n=2;fetch:delay:ms=20,n=5;"
+              f"dispatch:crash:after={max(3, n_chaos // 20)},n=1")
+    chaos_cfg = ServeConfig(max_wait_ms=5.0, max_batch=max_batch,
+                            mesh=mesh, faults=faults,
+                            restart_backoff_s=0.01,
+                            supervise_interval_s=0.02,
+                            result_timeout_s=120.0)
+    server = ConsensusServer(chaos_cfg)
+    try:
+        server.warmup(chaos_clusters, batch_sizes=(1, max_batch))
+        futures = []
+        for c in chaos_clusters:
+            while True:
+                try:
+                    futures.append(server.submit(c))
+                    break
+                except QueueFullError:
+                    futures[0].result()
+            time.sleep(rng.exponential(1.0 / lam))
+        chaos_responses = [
+            f.result(timeout=chaos_cfg.result_timeout_s)
+            for f in futures
+        ]
+        health = server.health()
+        csnap = server.snapshot()
+    finally:
+        server.close()
+    n_ok = sum(r.ok for r in chaos_responses)
+    out["chaos"] = {
+        "n_requests": n_chaos,
+        "faults": faults,
+        "availability": round(n_ok / n_chaos, 4),
+        "all_resolved_typed": all(
+            r.ok or r.error is not None for r in chaos_responses
+        ),
+        "p99_ms": csnap["latency_ms"].get("p99"),
+        "worker_restarts": health["worker_restarts"],
+        "retry_ladder": health["retry_ladder"],
+    }
     print(json.dumps(out))
 
 

@@ -37,6 +37,7 @@ import sys
 import threading
 import time
 from collections import deque
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import List, Optional
 
 import numpy as np
@@ -93,8 +94,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-file", default="",
                    help="JSONL file of example requests whose shape "
                         "buckets are pre-traced before serving")
+    p.add_argument("--faults", default="",
+                   help="fault-injection spec (serve/faults.py grammar, "
+                        "e.g. 'dispatch:error:n=2'); overrides the "
+                        "RIFRAF_TPU_FAULTS env var")
     p.add_argument("--stats", action="store_true",
-                   help="print the metrics snapshot as JSON to stderr "
+                   help="print the metrics snapshot (including the "
+                        "supervision health block) as JSON to stderr "
                         "on exit")
     p.add_argument("--verbose", "-v", type=int, default=0)
     return p
@@ -110,6 +116,8 @@ def config_from_args(args) -> ServeConfig:
     )
     if args.seq_errors:
         kw["scores"] = parse_error_model(args.seq_errors)
+    if args.faults:
+        kw["faults"] = args.faults
     return ServeConfig(**kw)
 
 
@@ -175,15 +183,27 @@ def serve_stream(lines, server: ConsensusServer, emitter: _Emitter,
             emitter.emit({"id": rid or f"line{i}", "ok": False,
                           "error": "bad_request", "message": str(e)})
             continue
+        t0 = time.perf_counter()
+        wait_s = server.config.result_timeout_s
         while True:
             try:
                 fut = server.submit(cluster, request_id=rid,
                                     deadline_ms=deadline_ms)
                 break
-            except QueueFullError:
-                # backpressure: wait out the oldest in-flight request
+            except QueueFullError as e:
+                # backpressure: wait out the oldest in-flight request —
+                # but bounded, so a dead pipeline (which never frees
+                # the queue) surfaces as a typed response, not a hang
+                if time.perf_counter() - t0 > wait_s:
+                    fut = None
+                    emitter.emit({"id": rid or f"line{i}", "ok": False,
+                                  "error": e.code, "message": str(e)})
+                    break
                 if inflight:
-                    inflight.popleft().result()
+                    try:
+                        inflight.popleft().result(timeout=1.0)
+                    except FutureTimeoutError:
+                        pass
                 else:
                     time.sleep(1e-3)
             except ServeError as e:
@@ -196,7 +216,15 @@ def serve_stream(lines, server: ConsensusServer, emitter: _Emitter,
             fut.add_done_callback(emitter.emit_response)
             n += 1
     while inflight:
-        inflight.popleft().result()
+        try:
+            inflight.popleft().result(
+                timeout=server.config.result_timeout_s)
+        except FutureTimeoutError:
+            # dead pipeline: stop waiting — close() resolves every
+            # abandoned future (ServerClosedError), and the done
+            # callbacks emit those responses, so no request goes
+            # unanswered
+            break
     return n
 
 

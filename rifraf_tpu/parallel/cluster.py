@@ -129,6 +129,7 @@ def pipeline_map(
     collect_fn: Callable[[object], R],
     items: Sequence[T],
     on_error: str = "raise",
+    stage_hook: Optional[Callable[[str, int], None]] = None,
 ) -> List[R]:
     """Two-deep host/device software pipeline over ``items``.
 
@@ -152,6 +153,13 @@ def pipeline_map(
     failures per job: a failing item's result slot holds a
     PipelineJobError naming the job index and stage (its remaining
     stages are skipped), and every other item still runs to completion.
+
+    ``stage_hook(stage, job_index)``, when given, is called immediately
+    before each stage executes — the serve worker's supervision
+    heartbeat and fault-injection hook point. Exceptions it raises are
+    treated exactly like the stage itself failing (``on_error``
+    applies); BaseExceptions (injected crashes) propagate and kill the
+    hosting thread, which is the scenario the supervisor recovers from.
     """
     if on_error not in ("raise", "return"):
         raise ValueError(f"unknown on_error: {on_error!r}")
@@ -161,6 +169,8 @@ def pipeline_map(
 
     def pack(i: int, item: T):
         try:
+            if stage_hook is not None:
+                stage_hook("pack", i)
             return pack_fn(item)
         except Exception as e:  # noqa: BLE001 — isolation is the point
             if on_error == "raise":
@@ -171,6 +181,8 @@ def pipeline_map(
         if isinstance(arg, PipelineJobError):
             return arg  # an earlier stage already failed this job
         try:
+            if stage_hook is not None:
+                stage_hook(stage, i)
             return fn(arg)
         except Exception as e:  # noqa: BLE001
             if on_error == "raise":

@@ -4,7 +4,11 @@ An always-on counterpart to the offline ``parallel.sweep_clusters_sharded``
 sweep: requests (one read cluster each) are admitted through a bounded
 queue with per-request deadlines, micro-batched by the sweep scheduler's
 shape-bucket signature, and dispatched double-buffered through the SAME
-lru-cached compiled programs the offline sweep uses. See docs/serving.md.
+lru-cached compiled programs the offline sweep uses. The server is
+supervised: a fault-injection plane (``serve.faults``), a watchdog that
+restarts a crashed worker thread, and a degradation ladder that retries
+failed micro-batches at progressively simpler execution rungs. See
+docs/serving.md.
 """
 
 from .batcher import MicroBatcher
@@ -15,6 +19,15 @@ from .errors import (
     QueueFullError,
     ServeError,
     ServerClosedError,
+    ServerUnhealthyError,
+    WaitTimeoutError,
+    WorkerCrashError,
+)
+from .faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedCrashError,
+    InjectedFaultError,
 )
 from .request import Request, Response, ServeConfig, encode_cluster
 from .server import ConsensusServer, submit_many
@@ -25,6 +38,10 @@ __all__ = [
     "ConsensusServer",
     "DeadlineExceededError",
     "EmptyClusterError",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedCrashError",
+    "InjectedFaultError",
     "InternalError",
     "MicroBatcher",
     "OversizeError",
@@ -35,6 +52,9 @@ __all__ = [
     "ServeError",
     "ServerClosedError",
     "ServerStats",
+    "ServerUnhealthyError",
+    "WaitTimeoutError",
+    "WorkerCrashError",
     "encode_cluster",
     "submit_many",
 ]

@@ -47,6 +47,36 @@ class EmptyClusterError(ServeError):
 
 
 class ServerClosedError(ServeError):
-    """submit() after close()."""
+    """submit() after close(), or a request abandoned by close(): the
+    drain deadline expired with the request still unresolved, so the
+    server resolved its future with this error instead of leaving it
+    hanging (the no-hung-futures-ever invariant)."""
 
     code = "server_closed"
+
+
+class WorkerCrashError(ServeError):
+    """The worker thread died (crashed) while this request was in
+    flight, and the request could not be recovered: its retry budget
+    was already spent, or the supervisor's restart cap was reached
+    (the server is unhealthy). Requests WITH budget are re-run on the
+    restarted worker instead of receiving this error."""
+
+    code = "worker_crash"
+
+
+class ServerUnhealthyError(ServeError):
+    """submit() while the server is unhealthy: the supervisor exhausted
+    its worker-restart cap (crash loop) and stopped taking traffic."""
+
+    code = "server_unhealthy"
+
+
+class WaitTimeoutError(ServeError):
+    """A synchronous wait on a request's result exceeded its timeout
+    (``ServeConfig.result_timeout_s`` or the deadline-derived bound).
+    The convenience waiters (``submit_many``, the CLI drain) convert
+    this into an ``ok=False`` response instead of blocking forever on
+    a dead worker."""
+
+    code = "wait_timeout"

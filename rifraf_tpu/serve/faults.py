@@ -1,0 +1,218 @@
+"""Deterministic fault injection for the serving substrate.
+
+Production hardening needs failures on demand: a transient device error,
+a worker thread dying mid-flush, a slow fetch — none of which occur
+naturally on a healthy dev box. A ``FaultPlan`` injects typed exceptions
+or delays at named sites, deterministically (by invocation count and/or
+a seeded Bernoulli draw), so the chaos test grid and the bench chaos
+config can provoke every failure path reproducibly.
+
+Sites (fired by the server/worker at the matching point):
+
+- ``admit``    — ``ConsensusServer.submit``, after validation, before
+  the request enters the admission queue (raises to the CALLER);
+- ``pack``     — ``Worker._pack``, the host-side batch build;
+- ``compile``  — ``Worker.plan_for``/``seg_plan_for``, where the
+  lru-cached program factories are keyed;
+- ``dispatch`` — ``Worker._run``, before the device dispatch;
+- ``fetch``    — ``Worker._collect``, before the blocking fetch;
+- ``fallback`` — ``Worker._run_fallback``, the per-cluster device loop.
+
+Kinds:
+
+- ``error`` raises ``InjectedFaultError`` — a plain ``RuntimeError``
+  subclass, deliberately NOT a ``ServeError``, so it travels the
+  unexpected-exception paths (pipeline isolation, the retry ladder);
+- ``crash`` raises ``InjectedCrashError`` — a ``BaseException``
+  subclass that escapes every ``except Exception`` handler and kills
+  the worker thread outright (the SIGKILL-style death the supervisor
+  watchdog exists for);
+- ``delay`` sleeps ``ms`` milliseconds (stall/watchdog testing).
+
+Spec grammar (``RIFRAF_TPU_FAULTS`` env var or ``ServeConfig.faults``)::
+
+    specs   := spec (";" spec)*
+    spec    := site ":" kind [":" opts]
+    opts    := opt ("," opt)*
+    opt     := "n=" int      max fires (default 1; 0 = unlimited)
+             | "after=" int  skip the first N invocations of the site
+             | "p=" float    fire probability (seeded Bernoulli)
+             | "seed=" int   RNG seed for p (default 0)
+             | "ms=" float   delay milliseconds (kind=delay)
+
+e.g. ``"dispatch:error:n=2;fetch:delay:ms=50;pack:crash:after=3"``.
+All counting is thread-safe; ``snapshot()`` reports per-site invocation
+and per-spec fire counts for ``ConsensusServer.health()``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+ENV_VAR = "RIFRAF_TPU_FAULTS"
+
+SITES = ("admit", "pack", "compile", "dispatch", "fetch", "fallback")
+KINDS = ("error", "crash", "delay")
+
+
+class InjectedFaultError(RuntimeError):
+    """An injected recoverable fault (kind="error"). Not a ServeError:
+    it must look like an unexpected internal failure to every handler."""
+
+
+class InjectedCrashError(BaseException):
+    """An injected thread-killing fault (kind="crash"). Derives from
+    BaseException so ``except Exception`` isolation (pipeline_map, the
+    worker loop wrap) does NOT contain it — the hosting thread dies,
+    which is the scenario the supervisor watchdog recovers from."""
+
+
+@dataclass
+class FaultSpec:
+    """One injection rule at one site."""
+
+    site: str
+    kind: str  # "error" | "crash" | "delay"
+    n: int = 1  # max fires; 0 = unlimited
+    after: int = 0  # skip the first `after` invocations of the site
+    p: float = 1.0  # fire probability per eligible invocation
+    seed: int = 0  # Bernoulli RNG seed (deterministic across runs)
+    ms: float = 0.0  # delay milliseconds (kind="delay")
+    fired: int = 0  # mutable: how many times this spec has fired
+    _rng: random.Random = field(default=None, repr=False)  # type: ignore
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r} (sites: {SITES})"
+            )
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (kinds: {KINDS})"
+            )
+        self._rng = random.Random(self.seed)
+
+
+class FaultPlan:
+    """A thread-safe set of ``FaultSpec`` rules plus fire accounting."""
+
+    def __init__(self, specs: Sequence[FaultSpec] = ()):
+        self.specs: List[FaultSpec] = list(specs)
+        self._lock = threading.Lock()
+        self._site_calls: Dict[str, int] = {}
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    # ---- construction ----
+
+    @classmethod
+    def parse(cls, text: Optional[str]) -> "FaultPlan":
+        """Parse the spec grammar (see module docstring). Empty/None
+        yields an inert plan."""
+        specs: List[FaultSpec] = []
+        for raw in (text or "").split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            parts = raw.split(":", 2)
+            if len(parts) < 2:
+                raise ValueError(
+                    f"fault spec {raw!r} needs at least site:kind"
+                )
+            site, kind = parts[0].strip(), parts[1].strip()
+            kw: dict = {}
+            if len(parts) == 3 and parts[2].strip():
+                for opt in parts[2].split(","):
+                    k, _, v = opt.partition("=")
+                    k = k.strip()
+                    if not _:
+                        raise ValueError(
+                            f"fault option {opt!r} is not key=value"
+                        )
+                    if k in ("n", "after", "seed"):
+                        kw[k] = int(v)
+                    elif k in ("p", "ms"):
+                        kw[k] = float(v)
+                    else:
+                        raise ValueError(f"unknown fault option {k!r}")
+            specs.append(FaultSpec(site=site, kind=kind, **kw))
+        return cls(specs)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls.parse(os.environ.get(ENV_VAR, ""))
+
+    # ---- the injection point ----
+
+    def fire(self, site: str) -> None:
+        """One named-site invocation: count it, then let each matching
+        live spec act — delays sleep, errors/crashes raise. Thread-safe;
+        the sleep happens outside the lock."""
+        if not self.specs:
+            return
+        delay_s = 0.0
+        to_raise: Optional[BaseException] = None
+        with self._lock:
+            idx = self._site_calls.get(site, 0)
+            self._site_calls[site] = idx + 1
+            for s in self.specs:
+                if s.site != site:
+                    continue
+                if s.n and s.fired >= s.n:
+                    continue
+                if idx < s.after:
+                    continue
+                if s.p < 1.0 and s._rng.random() >= s.p:
+                    continue
+                s.fired += 1
+                if s.kind == "delay":
+                    delay_s += s.ms / 1e3
+                elif s.kind == "error":
+                    to_raise = InjectedFaultError(
+                        f"injected fault at site {site!r} "
+                        f"(invocation {idx})"
+                    )
+                    break
+                else:  # crash
+                    to_raise = InjectedCrashError(
+                        f"injected crash at site {site!r} "
+                        f"(invocation {idx})"
+                    )
+                    break
+        if delay_s:
+            time.sleep(delay_s)
+        if to_raise is not None:
+            raise to_raise
+
+    # ---- observability ----
+
+    def snapshot(self) -> dict:
+        """JSON-serializable fire accounting for health()."""
+        with self._lock:
+            return {
+                "site_calls": dict(self._site_calls),
+                "specs": [
+                    {"site": s.site, "kind": s.kind, "n": s.n,
+                     "after": s.after, "p": s.p, "fired": s.fired}
+                    for s in self.specs
+                ],
+            }
+
+
+def resolve_faults(spec) -> FaultPlan:
+    """ServeConfig.faults -> FaultPlan: pass a FaultPlan through, parse
+    a spec string, and fall back to the ``RIFRAF_TPU_FAULTS`` env var
+    for None (so a chaos run can be configured without code changes)."""
+    if isinstance(spec, FaultPlan):
+        return spec
+    if isinstance(spec, str):
+        return FaultPlan.parse(spec)
+    if spec is None:
+        return FaultPlan.from_env()
+    raise TypeError(f"faults must be FaultPlan | str | None, got {spec!r}")

@@ -75,6 +75,38 @@ class ServeConfig:
     max_reads: int = 4096
     max_len: int = 65536
 
+    # --- robustness / supervision ---
+    # deterministic fault injection: a serve.faults.FaultPlan, a spec
+    # string (see serve/faults.py grammar), or None to follow the
+    # RIFRAF_TPU_FAULTS env var (empty = no faults)
+    faults: Optional[object] = None
+    # supervisor thread: heartbeats the batcher/worker threads, restarts
+    # a crashed worker, watches for stalls
+    supervise: bool = True
+    supervise_interval_s: float = 0.05
+    # a worker busy on one burst for longer than this is counted as
+    # stalled (the worker_stalls counter; the thread cannot be killed,
+    # only observed — restart handles DEAD threads). The default sits
+    # above a cold first-compile so an unwarmed server does not count
+    # its own tracing as a stall
+    stall_timeout_s: float = 120.0
+    # crashed-worker restart cap + exponential backoff (backoff_s * 2^k
+    # before restart k); past the cap the server declares itself
+    # unhealthy, fails everything outstanding, and rejects new submits
+    max_restarts: int = 3
+    restart_backoff_s: float = 0.05
+    # degradation ladder: per-request retry budget across the rungs
+    # (segment-packed -> whole-block batch -> per-request fallback); 2
+    # covers the full descent
+    max_retries: int = 2
+    # synchronous waits (submit_many, CLI drain) give up after this long
+    # per request and report WaitTimeoutError instead of hanging on a
+    # dead pipeline; requests with deadlines derive a tighter bound
+    result_timeout_s: float = 300.0
+    # close(timeout=None) drains with this deadline before resolving
+    # abandoned futures with ServerClosedError; None = wait forever
+    close_timeout_s: Optional[float] = 60.0
+
     # --- engine parameters (the device-loop configuration) ---
     max_iters: int = 100
     min_dist: int = 5 * CODON_LENGTH
@@ -127,6 +159,8 @@ class Request:
     t_submit: float  # perf_counter at admission
     deadline: Optional[float]  # absolute perf_counter time, or None
     future: Future = field(default_factory=Future)
+    # degradation-ladder retry budget consumed so far (worker-owned)
+    retries: int = 0
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -1,20 +1,39 @@
 """ConsensusServer: the online consensus service front-end.
 
-Three threads cooperate:
+Three worker threads plus a supervisor cooperate:
 
 - the CALLER thread runs ``submit()``: admission checks (empty /
-  oversize / closed / queue-full) happen synchronously so typed errors
-  reach the caller immediately — backpressure is an exception, never a
-  block;
+  oversize / closed / unhealthy / queue-full) happen synchronously so
+  typed errors reach the caller immediately — backpressure is an
+  exception, never a block;
 - the BATCHER thread drains the admission queue into the MicroBatcher
   and pushes due flushes (bucket-full / max-wait / deadline-risk) to
   the worker's flush queue;
 - the WORKER thread (``worker.Worker.run_loop``) pipelines flushes
-  through the shared ChunkExecutor with double-buffered dispatch.
+  through the shared ChunkExecutor with double-buffered dispatch;
+- the SUPERVISOR thread heartbeats the other two. A dead worker thread
+  (a crash that escaped ``except Exception`` — the SIGKILL analogue) is
+  restarted after exponential backoff: the program factories are
+  module-level lru-cached, so a fresh ``Worker`` re-attaches to every
+  compiled executable for free. Its in-flight requests re-run one rung
+  down the degradation ladder when they still hold retry budget;
+  budget-exhausted ones fail with ``WorkerCrashError``. Past
+  ``max_restarts`` the server declares itself UNHEALTHY: everything
+  outstanding fails typed, and new submits raise
+  ``ServerUnhealthyError``. A live-but-silent worker past
+  ``stall_timeout_s`` is counted as a stall (observable in
+  ``health()``; a thread cannot be killed, only watched).
+
+The no-hung-futures invariant: every admitted request's future resolves
+— by the worker (ok / typed error), by the ladder, by the supervisor
+(crash recovery / unhealthy), or by ``close()``, whose drain deadline
+expiring resolves every abandoned future with ``ServerClosedError``.
 
 ``submit()`` returns a ``concurrent.futures.Future[Response]``;
 ``submit_many()`` is the synchronous batch convenience that rides the
-backpressure signal instead of surfacing it.
+backpressure signal instead of surfacing it, with every wait bounded
+(``result_timeout_s``) so a dead pipeline yields typed
+``WaitTimeoutError`` responses, never a hang.
 """
 
 from __future__ import annotations
@@ -23,8 +42,9 @@ import itertools
 import threading
 import time
 from collections import deque
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from queue import Empty, Full, Queue
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from ..models.sequences import ReadScores
 from .batcher import MicroBatcher
@@ -34,66 +54,144 @@ from .errors import (
     QueueFullError,
     ServeError,
     ServerClosedError,
+    ServerUnhealthyError,
+    WaitTimeoutError,
+    WorkerCrashError,
 )
+from .faults import resolve_faults
 from .request import Request, Response, ServeConfig
 from .stats import ServerStats
 from .worker import STOP, Flush, Worker, respond_error
 
 _SHUTDOWN = object()  # admission-queue shutdown sentinel
+_UNSET = object()  # close(timeout=...) default marker
 
 
 class ConsensusServer:
-    """Online consensus with continuous micro-batching and deadlines."""
+    """Online consensus with continuous micro-batching, deadlines, and
+    supervised fault recovery."""
 
     def __init__(self, config: Optional[ServeConfig] = None,
                  stats: Optional[ServerStats] = None, start: bool = True):
         self.config = config or ServeConfig()
         self.stats = stats or ServerStats()
+        self.faults = resolve_faults(self.config.faults)
         self._admit_q: Queue = Queue(maxsize=self.config.max_queue)
         self._flush_q: Queue = Queue()
         self._batcher = MicroBatcher(self.config)
-        self._worker = Worker(self.config, self.stats)
+        self._worker = Worker(self.config, self.stats, self.faults)
         self._ids = itertools.count()
         self._closed = False
-        self._threads: List[threading.Thread] = []
+        self._unhealthy = False
+        # every admitted, not-yet-resolved request, so close() and the
+        # unhealthy transition can resolve them all (keyed by object
+        # identity; a done-callback removes entries the moment any
+        # resolver wins)
+        self._outstanding: Dict[int, Request] = {}
+        self._outstanding_lock = threading.Lock()
+        self._batcher_thread: Optional[threading.Thread] = None
+        self._worker_thread: Optional[threading.Thread] = None
+        self._supervisor_thread: Optional[threading.Thread] = None
+        self._stop_supervisor = threading.Event()
+        self._worker_restarts = 0
+        self._batcher_restarts = 0
+        self._last_stall_beat: Optional[float] = None
         if start:
             self.start()
 
     # ---- lifecycle ----
 
     def start(self) -> "ConsensusServer":
-        if self._threads:
+        if self._batcher_thread is not None:
             return self
+        self._batcher_thread = self._spawn_batcher()
+        self._worker_thread = self._spawn_worker()
+        if self.config.supervise:
+            st = threading.Thread(target=self._supervise_loop,
+                                  daemon=True,
+                                  name="rifraf-serve-supervisor")
+            self._supervisor_thread = st
+            st.start()
+        return self
+
+    def _spawn_batcher(self) -> threading.Thread:
         bt = threading.Thread(target=self._batch_loop, daemon=True,
                               name="rifraf-serve-batcher")
+        bt.start()
+        return bt
+
+    def _spawn_worker(self) -> threading.Thread:
         wt = threading.Thread(target=self._worker.run_loop,
                               args=(self._flush_q,), daemon=True,
                               name="rifraf-serve-worker")
-        self._threads = [bt, wt]
-        bt.start()
         wt.start()
-        return self
+        return wt
 
-    def close(self, timeout: Optional[float] = None) -> None:
-        """Drain pending work, then stop both threads. Requests already
-        admitted still complete; submit() afterwards raises
+    def close(self, timeout=_UNSET) -> None:
+        """Drain pending work with a deadline, then stop every thread
+        and resolve whatever is left.
+
+        ``timeout`` defaults to ``config.close_timeout_s`` (None = wait
+        forever). When the deadline expires with requests still
+        unresolved, each abandoned future is resolved with
+        ``ServerClosedError`` — a closed server NEVER leaves a caller
+        blocked on ``.result()``. submit() afterwards raises
         ServerClosedError."""
         if self._closed:
             return
         self._closed = True
-        if not self._threads:
-            return
-        bt, wt = self._threads
-        self._admit_q.put(_SHUTDOWN)
-        bt.join(timeout)
-        self._flush_q.put(STOP)
-        wt.join(timeout)
+        if timeout is _UNSET:
+            timeout = self.config.close_timeout_s
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+
+        def remaining() -> Optional[float]:
+            if deadline is None:
+                return None
+            return max(0.0, deadline - time.perf_counter())
+
+        # supervisor first: a restart racing the shutdown would re-spawn
+        # the threads being joined
+        self._stop_supervisor.set()
+        if self._supervisor_thread is not None:
+            self._supervisor_thread.join(remaining())
+        if self._batcher_thread is not None:
+            self._admit_q.put(_SHUTDOWN)
+            self._batcher_thread.join(remaining())
+            self._flush_q.put(STOP)
+            self._worker_thread.join(remaining())
+        # the no-hung-futures invariant: anything still unresolved —
+        # deadline expired mid-drain, worker dead, never started —
+        # resolves typed right now
+        for req in self._take_outstanding():
+            respond_error(req, ServerClosedError(
+                f"request {req.id}: abandoned by close()"
+            ), self.stats, "closed_abandoned")
 
     def __enter__(self) -> "ConsensusServer":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # ---- the outstanding-request registry ----
+
+    def _track(self, req: Request) -> None:
+        key = id(req)
+        with self._outstanding_lock:
+            self._outstanding[key] = req
+        req.future.add_done_callback(
+            lambda _f, k=key: self._untrack(k))
+
+    def _untrack(self, key: int) -> None:
+        with self._outstanding_lock:
+            self._outstanding.pop(key, None)
+
+    def _take_outstanding(self) -> List[Request]:
+        with self._outstanding_lock:
+            reqs = list(self._outstanding.values())
+            self._outstanding.clear()
+        return reqs
 
     # ---- admission (caller thread) ----
 
@@ -102,7 +200,8 @@ class ConsensusServer:
                deadline_ms: Optional[float] = None):
         """Admit one cluster; returns Future[Response].
 
-        Raises synchronously: ServerClosedError, EmptyClusterError,
+        Raises synchronously: ServerClosedError, ServerUnhealthyError
+        (worker crash loop — the supervisor gave up), EmptyClusterError,
         OversizeError (hard shape limits), QueueFullError (bounded
         admission queue — the backpressure signal; back off and retry).
         """
@@ -110,6 +209,10 @@ class ConsensusServer:
 
         if self._closed:
             raise ServerClosedError("server is closed")
+        if self._unhealthy:
+            raise ServerUnhealthyError(
+                "server is unhealthy (worker restart cap exceeded)"
+            )
         if not cluster:
             raise EmptyClusterError("request carries no reads")
         cfg = self.config
@@ -120,6 +223,10 @@ class ConsensusServer:
                 f"{info.max_len}) exceeds hard limits "
                 f"({cfg.max_reads} reads, len {cfg.max_len})"
             )
+        # the admit fault site: after validation, before the queue — an
+        # injected error here reaches the CALLER, like any admission
+        # rejection
+        self.faults.fire("admit")
         now = time.perf_counter()
         req = Request(
             id=request_id if request_id is not None
@@ -145,6 +252,7 @@ class ConsensusServer:
             raise QueueFullError(
                 f"admission queue at capacity ({cfg.max_queue})"
             ) from None
+        self._track(req)
         self.stats.count("submitted")
         return req.future
 
@@ -194,6 +302,123 @@ class ConsensusServer:
         self.stats.count(counter)
         self._flush_q.put(Flush(kind, requests))
 
+    # ---- supervisor thread ----
+
+    def _supervise_loop(self) -> None:
+        interval = self.config.supervise_interval_s
+        while not self._stop_supervisor.wait(interval):
+            if self._closed:
+                return
+            try:
+                self._check_batcher()
+                self._check_worker()
+            except Exception:  # noqa: BLE001 — the watchdog must live
+                self.stats.count("supervisor_errors")
+
+    def _check_batcher(self) -> None:
+        bt = self._batcher_thread
+        if bt is None or bt.is_alive():
+            return
+        self.stats.count("batcher_crashes")
+        if self._batcher_restarts >= self.config.max_restarts:
+            self._declare_unhealthy()
+            return
+        self._backoff(self._batcher_restarts)
+        if self._closed or self._stop_supervisor.is_set():
+            return
+        self._batcher_restarts += 1
+        self.stats.count("batcher_restarts")
+        # MicroBatcher state lives on self and survives the thread; a
+        # restarted loop picks the pending buckets straight back up
+        self._batcher_thread = self._spawn_batcher()
+
+    def _check_worker(self) -> None:
+        wt = self._worker_thread
+        w = self._worker
+        if wt is not None and wt.is_alive():
+            # alive: watch for a stall (busy with no heartbeat). One
+            # count per stalled burst — last_beat only moves when the
+            # worker does, so it keys the episode.
+            if w.busy:
+                age = time.perf_counter() - w.last_beat
+                if (age > self.config.stall_timeout_s
+                        and w.last_beat != self._last_stall_beat):
+                    self._last_stall_beat = w.last_beat
+                    self.stats.count("worker_stalls")
+            return
+        # dead worker: the crash escaped every except-Exception layer
+        self.stats.count("worker_crashes")
+        crashed = w.take_inflight()
+        if self._worker_restarts >= self.config.max_restarts:
+            self._declare_unhealthy(crashed)
+            return
+        self._backoff(self._worker_restarts)
+        if self._closed or self._stop_supervisor.is_set():
+            return  # close() resolves the crashed requests
+        self._worker_restarts += 1
+        self.stats.count("worker_restarts")
+        # a fresh Worker re-attaches to the module-level lru-cached
+        # program factories: no recompilation, same executables
+        self._worker = Worker(self.config, self.stats, self.faults)
+        self._worker_thread = self._spawn_worker()
+        self._requeue_crashed(crashed)
+
+    def _backoff(self, k: int) -> None:
+        # interruptible exponential backoff before restart k
+        self._stop_supervisor.wait(
+            self.config.restart_backoff_s * (2 ** k))
+
+    def _requeue_crashed(self, flushes: List[Flush]) -> None:
+        """Crash recovery for the dead worker's in-flight requests:
+        re-run each one rung DOWN the ladder while it has retry budget
+        (a crashed rung-0 batch re-runs whole-block; anything deeper
+        re-runs per-request fallback; a crashed fallback retries as
+        fallback — transient faults clear, persistent ones exhaust the
+        budget). Budget-exhausted requests fail with WorkerCrashError."""
+        for flush in flushes:
+            retryable: List[Request] = []
+            for r in flush.requests:
+                if r.future.done():
+                    continue
+                if r.retries < self.config.max_retries:
+                    r.retries += 1
+                    retryable.append(r)
+                else:
+                    self.stats.count("ladder_exhausted")
+                    respond_error(r, WorkerCrashError(
+                        f"request {r.id}: worker crashed and the retry "
+                        f"budget is spent"
+                    ), self.stats, "failed_crash")
+            if not retryable:
+                continue
+            if flush.kind == "batch" and flush.rung == 0:
+                self.stats.count("ladder_retry_block", len(retryable))
+                self._flush_q.put(Flush("batch", retryable, 1))
+            else:
+                self.stats.count("ladder_retry_fallback",
+                                 len(retryable))
+                for r in retryable:
+                    self._flush_q.put(Flush("fallback", [r], 2))
+
+    def _declare_unhealthy(self,
+                           crashed: Sequence[Flush] = ()) -> None:
+        """Restart cap exceeded (crash loop): stop taking traffic and
+        fail everything outstanding with a typed error — an unhealthy
+        server still never hangs a future."""
+        if self._unhealthy:
+            return
+        self._unhealthy = True
+        self.stats.count("declared_unhealthy")
+        err = WorkerCrashError(
+            "server unhealthy: worker restart cap "
+            f"({self.config.max_restarts}) exceeded"
+        )
+        for flush in crashed:
+            for r in flush.requests:
+                respond_error(r, err, self.stats, "failed_crash")
+        for req in self._take_outstanding():
+            respond_error(req, err, self.stats, "failed_crash")
+
     # ---- warmup / observability ----
 
     def warmup(self, example_clusters: Sequence[Sequence[ReadScores]],
@@ -237,8 +462,35 @@ class ConsensusServer:
     def queue_depth(self) -> int:
         return self._admit_q.qsize() + self._batcher.depth()
 
+    def health(self) -> dict:
+        """Liveness/supervision snapshot (JSON-serializable): thread
+        liveness, worker heartbeat age, restart and stall counts, the
+        retry-ladder counters, outstanding-request count, and the
+        fault plan's fire accounting when faults are configured."""
+        bt, wt = self._batcher_thread, self._worker_thread
+        w = self._worker
+        now = time.perf_counter()
+        out = {
+            "healthy": not (self._unhealthy or self._closed),
+            "closed": self._closed,
+            "unhealthy": self._unhealthy,
+            "batcher_alive": bool(bt is not None and bt.is_alive()),
+            "worker_alive": bool(wt is not None and wt.is_alive()),
+            "worker_busy": w.busy,
+            "last_flush_age_s": round(now - w.last_beat, 3),
+            "worker_restarts": self._worker_restarts,
+            "batcher_restarts": self._batcher_restarts,
+            "retry_ladder": self.stats.ladder(),
+            "outstanding": len(self._outstanding),
+        }
+        if self.faults:
+            out["faults"] = self.faults.snapshot()
+        return out
+
     def snapshot(self) -> dict:
-        return self.stats.snapshot(queue_depth=self.queue_depth())
+        out = self.stats.snapshot(queue_depth=self.queue_depth())
+        out["health"] = self.health()
+        return out
 
 
 def submit_many(
@@ -252,15 +504,28 @@ def submit_many(
 
     Rides the backpressure protocol for the caller: on QueueFullError it
     waits for the oldest in-flight request to finish and retries. Other
-    admission rejections (oversize, empty) become ``ok=False``
+    admission rejections (oversize, empty, unhealthy) become ``ok=False``
     Responses so alignment with the input list is preserved.
+
+    Every wait is bounded by ``config.result_timeout_s`` (tightened by
+    ``deadline_ms`` when given): a dead or wedged pipeline yields typed
+    ``WaitTimeoutError`` / ``QueueFullError`` responses instead of
+    blocking this call forever.
     """
     own = server is None
     srv = server if server is not None else ConsensusServer(config)
+    cfg = srv.config
+    # how long any single wait may block: the request deadline plus the
+    # flush margin when a deadline exists, the global cap otherwise
+    wait_s = cfg.result_timeout_s
+    if deadline_ms is not None:
+        wait_s = min(wait_s,
+                     deadline_ms / 1e3 + cfg.result_timeout_s / 10.0)
     try:
         slots: List[object] = [None] * len(clusters)
         inflight: deque = deque()
         for i, c in enumerate(clusters):
+            t0 = time.perf_counter()
             while True:
                 try:
                     fut = srv.submit(c, request_id=f"c{i}",
@@ -268,9 +533,20 @@ def submit_many(
                     slots[i] = fut
                     inflight.append(fut)
                     break
-                except QueueFullError:
+                except QueueFullError as e:
+                    # bounded backpressure: wait for the oldest
+                    # in-flight slot, but give up on this submission
+                    # once the budget is spent (a dead worker never
+                    # frees the queue)
+                    if time.perf_counter() - t0 > wait_s:
+                        slots[i] = e
+                        break
                     if inflight:
-                        inflight.popleft().result()
+                        try:
+                            inflight.popleft().result(timeout=min(
+                                1.0, wait_s))
+                        except FutureTimeoutError:
+                            pass
                     else:
                         time.sleep(1e-3)
                 except ServeError as e:
@@ -281,8 +557,18 @@ def submit_many(
             if isinstance(s, ServeError):
                 out.append(Response(id=f"c{i}", ok=False, error=s,
                                     path="rejected"))
-            else:
-                out.append(s.result())
+                continue
+            try:
+                out.append(s.result(timeout=wait_s))
+            except FutureTimeoutError:
+                srv.stats.count("wait_timeouts")
+                out.append(Response(
+                    id=f"c{i}", ok=False,
+                    error=WaitTimeoutError(
+                        f"request c{i}: no result within {wait_s:g}s"
+                    ),
+                    path="rejected",
+                ))
         return out
     finally:
         if own:

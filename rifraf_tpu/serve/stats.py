@@ -97,12 +97,27 @@ class ServerStats:
             "n": int(lat.size),
         }
 
+    def ladder(self) -> Dict[str, int]:
+        """The degradation-ladder counters (``ladder_*``), un-prefixed:
+        retries per rung, recoveries, budget exhaustions."""
+        with self._lock:
+            return {
+                k[len("ladder_"):]: v
+                for k, v in self._counters.items()
+                if k.startswith("ladder_")
+            }
+
     def snapshot(self, queue_depth: Optional[int] = None) -> dict:
         """JSON-serializable state: counters, occupancy, padding waste,
         latency percentiles (ms), decline reasons, timer sections."""
         with self._lock:
             out = {
                 "counters": dict(self._counters),
+                "retry_ladder": {
+                    k[len("ladder_"):]: v
+                    for k, v in self._counters.items()
+                    if k.startswith("ladder_")
+                },
                 "batches": self._batches,
                 "batch_occupancy": round(
                     self._batched_requests / self._padded_slots, 4

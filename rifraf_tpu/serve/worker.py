@@ -16,13 +16,34 @@ Two flush kinds:
   device loop (``rifraf()`` in the sweep-equivalent configuration) so a
   3 kb outlier degrades gracefully instead of dragging a whole bucket's
   padded shape up with it.
+
+Failure handling is a DEGRADATION LADDER, not all-or-nothing: a failed
+micro-batch retries once at the next-simpler execution rung —
+segment-packed (rung 0) -> whole-block batch (rung 1) -> per-request
+device-loop fallback (rung 2) — under a bounded per-request retry
+budget (``ServeConfig.max_retries``). Every rung is bit-identical to
+the others for a given request (tests/test_lane_packing.py,
+tests/test_serve.py), so a ladder-recovered response equals the
+unfaulted one. ``Flush.rung`` pins a flush to a rung; the supervisor
+uses it to re-run a crashed worker's in-flight requests one rung down.
+
+The loop itself is hardened: a ``STOP`` discovered mid-burst still runs
+the already-collected flushes before exiting, an unexpected exception
+in the burst machinery fails that burst's requests (typed
+``InternalError``) instead of killing the thread silently, and every
+terminal resolution tolerates a concurrent resolver
+(``InvalidStateError`` -> counted no-op) so two racing terminals can
+never take the worker down. Injected faults (serve.faults) fire at the
+``pack``/``compile``/``dispatch``/``fetch``/``fallback`` sites, on the
+ladder's inline retries as well as the pipelined first attempt.
 """
 
 from __future__ import annotations
 
 import time
+from concurrent.futures import InvalidStateError
 from queue import Empty, Queue
-from typing import List, NamedTuple
+from typing import List, NamedTuple, Optional
 
 from ..parallel.cluster import PipelineJobError, pipeline_map
 from ..parallel.sweep_sharded import (
@@ -37,10 +58,16 @@ from ..utils.shapes import bucket as _bucket
 from ..utils.shapes import pack_segments, pow2_bucket
 from .batcher import resolve_segment_pack, segment_eligible
 from .errors import DeadlineExceededError, ServeError
+from .faults import FaultPlan, resolve_faults
 from .request import Request, Response, ServeConfig
 from .stats import ServerStats
 
 STOP = object()  # flush-queue shutdown sentinel
+
+# ladder rungs: 0 = auto (segment-packed when eligible), 1 = whole-block
+# micro-batch (segment packing disabled), 2 = per-request device-loop
+# fallback. Counters are keyed by the rung a RETRY lands on.
+RUNG_NAMES = {0: "auto", 1: "block", 2: "fallback"}
 
 
 def _batch_model_bytes(plan: BucketPlan, results: List[SweepResult]):
@@ -63,6 +90,7 @@ def _batch_model_bytes(plan: BucketPlan, results: List[SweepResult]):
 class Flush(NamedTuple):
     kind: str  # "batch" | "fallback"
     requests: List[Request]
+    rung: int = 0  # degradation-ladder rung this flush executes at
 
 
 class InternalError(ServeError):
@@ -71,23 +99,43 @@ class InternalError(ServeError):
     code = "internal"
 
 
+def resolve_future(req: Request, response: Response,
+                   stats: ServerStats) -> bool:
+    """Resolve a request's future, tolerating a concurrent resolver:
+    two terminals can interleave (worker vs supervisor vs close()), and
+    the done() pre-check alone is racy — the second set_result raises
+    InvalidStateError, which must be a counted no-op, never a
+    worker-killing exception. Returns whether THIS call resolved it."""
+    if req.future.done():
+        stats.count("double_resolve")
+        return False
+    try:
+        req.future.set_result(response)
+        return True
+    except InvalidStateError:
+        stats.count("double_resolve")
+        return False
+
+
 def respond_error(req: Request, err: ServeError, stats: ServerStats,
                   counter: str) -> None:
-    if req.future.done():
-        return
     lat = time.perf_counter() - req.t_submit
-    stats.count(counter)
-    req.future.set_result(Response(
+    if resolve_future(req, Response(
         id=req.id, ok=False, error=err, latency_s=lat, path="rejected",
-    ))
+    ), stats):
+        stats.count(counter)
 
 
 class Worker:
     """Owns the ChunkExecutor and the flush-queue consumer loop."""
 
-    def __init__(self, config: ServeConfig, stats: ServerStats):
+    def __init__(self, config: ServeConfig, stats: ServerStats,
+                 faults: Optional[FaultPlan] = None):
         self.config = config
         self.stats = stats
+        self.faults = faults if faults is not None else resolve_faults(
+            config.faults
+        )
         self.segment_pack = resolve_segment_pack(config)
         self.executor = ChunkExecutor(
             mesh=config.mesh,
@@ -96,6 +144,14 @@ class Worker:
             bandwidth_pvalue=config.bandwidth_pvalue,
             do_alignment_proposals=config.do_alignment_proposals,
         )
+        # supervision surface: the supervisor reads these to detect a
+        # crashed/stalled worker and to recover its in-flight requests
+        self.last_beat = time.perf_counter()
+        self.busy = False
+        self.inflight: List[Flush] = []
+
+    def _heartbeat(self, *_ignored) -> None:
+        self.last_beat = time.perf_counter()
 
     # ---- pipeline stages (pack on the background thread, run/collect
     # on the worker thread) ----
@@ -104,6 +160,7 @@ class Worker:
         """One-chunk plan for a micro-batch of n clusters: the cluster
         axis rounds to the next power of two (and the mesh axis) so the
         number of distinct compiled batch shapes stays logarithmic."""
+        self.faults.fire("compile")
         mesh = self.config.mesh
         n_axis = mesh.devices.size if mesh is not None else 1
         gp = _bucket(pow2_bucket(n), max(n_axis, 1))
@@ -128,6 +185,7 @@ class Worker:
         (utils.shapes.pack_segments); member indices index into the
         flush's request list. The pack-count axis rounds to the next
         power of two (and the mesh axis) like plan_for."""
+        self.faults.fire("compile")
         cfg = self.config
         pk = pack_segments(
             [r.info.n_reads for r in requests], lanes=cfg.lane_target
@@ -157,6 +215,7 @@ class Worker:
     def _pack(self, flush: Flush):
         if flush.kind != "batch":
             return flush, None
+        self.faults.fire("pack")
         now = time.perf_counter()
         live = []
         for r in flush.requests:
@@ -167,9 +226,11 @@ class Worker:
             else:
                 live.append(r)
         if not live:
-            return Flush("batch", []), None
+            return Flush("batch", [], flush.rung), None
         with self.stats.timers.time("serve_pack"):
-            seg = self._seg_batch(live)
+            # rung >= 1 pins the whole-block path: the ladder's
+            # "next-simpler" retry must not re-enter segment packing
+            seg = flush.rung < 1 and self._seg_batch(live)
             key = live[0].key
             if seg:
                 plan, packs = self.seg_plan_for(live)
@@ -184,9 +245,12 @@ class Worker:
                     # only shares the SHAPE axes, so the whole-block
                     # fallback pads to the flush's per-axis maxima.
                     seg = False
-                    key = tuple(
-                        max(r.key[i] for r in live) for i in range(4)
-                    )
+            if not seg and (flush.rung >= 1 or flush.kind == "batch"):
+                # a mixed/laddered flush only shares the SHAPE axes;
+                # per-axis maxima cover every member
+                key = tuple(
+                    max(r.key[i] for r in live) for i in range(4)
+                )
             if seg:
                 packed = self.executor.pack_seg(
                     plan, packs, [r.cluster for r in live],
@@ -198,7 +262,7 @@ class Worker:
                     plan, range(len(live)), [r.cluster for r in live],
                     [r.info for r in live],
                 )
-        return Flush("batch", live), (plan, packed)
+        return Flush("batch", live, flush.rung), (plan, packed)
 
     def _run(self, arg):
         flush, staged = arg
@@ -206,6 +270,7 @@ class Worker:
             return flush, self._run_fallback(flush.requests[0])
         if staged is None:
             return flush, None
+        self.faults.fire("dispatch")
         plan, packed = staged
         seg = isinstance(plan, SegmentBucketPlan)
         with self.stats.timers.time("serve_dispatch"):
@@ -234,6 +299,7 @@ class Worker:
         if flush.kind == "fallback":
             self._respond_ok(flush.requests[0], handle, "fallback")
             return 1
+        self.faults.fire("fetch")
         if isinstance(handle[1], SegmentBucketPlan):
             with self.stats.timers.time("serve_fetch"):
                 pairs = self.executor.collect_seg(handle)
@@ -254,16 +320,14 @@ class Worker:
 
     def _respond_ok(self, req: Request, res: SweepResult,
                     path: str) -> None:
-        if req.future.done():
-            return
         lat = time.perf_counter() - req.t_submit
-        self.stats.observe_latency(lat)
-        self.stats.count("completed")
-        req.future.set_result(Response(
+        if resolve_future(req, Response(
             id=req.id, ok=True, consensus=res.consensus, score=res.score,
             n_iters=res.n_iters, converged=res.converged, latency_s=lat,
             path=path,
-        ))
+        ), self.stats):
+            self.stats.observe_latency(lat)
+            self.stats.count("completed")
 
     def _run_fallback(self, req: Request) -> SweepResult:
         """PR 1 per-cluster device loop, in the batched path's exact
@@ -273,6 +337,7 @@ class Worker:
         from ..engine.driver import rifraf
         from ..engine.params import RifrafParams
 
+        self.faults.fire("fallback")
         cfg = self.config
         with self.stats.timers.time("serve_fallback"):
             result = rifraf(
@@ -296,20 +361,106 @@ class Worker:
             converged=bool(result.state.converged),
         )
 
-    def _fail_flush(self, flush: Flush, err: PipelineJobError) -> None:
-        wrapped = InternalError(str(err))
-        wrapped.__cause__ = err.__cause__
+    # ---- the degradation ladder ----
+
+    def _wrap(self, err: BaseException) -> InternalError:
+        if isinstance(err, PipelineJobError):
+            wrapped = InternalError(str(err))
+            wrapped.__cause__ = err.__cause__
+        else:
+            wrapped = InternalError(f"micro-batch failed: {err!r}")
+            wrapped.__cause__ = err
+        return wrapped
+
+    def _fail_flush(self, flush: Flush, err: BaseException) -> None:
+        wrapped = self._wrap(err)
         for r in flush.requests:
             respond_error(r, wrapped, self.stats, "failed_internal")
 
+    def _retry_or_fail(self, flush: Flush, err: BaseException) -> None:
+        """One failed flush: descend the ladder for members with retry
+        budget, fail the rest (typed InternalError). A rung-0 batch
+        retries whole-block; everything deeper — including fallback
+        flushes, which have no simpler rung — retries per-request
+        fallback, so a transient fault there still clears. The
+        per-request budget bounds the recursion."""
+        cfg = self.config
+        wrapped = self._wrap(err)
+        retryable: List[Request] = []
+        for r in flush.requests:
+            if r.future.done():
+                continue
+            if r.retries < cfg.max_retries:
+                r.retries += 1
+                retryable.append(r)
+            else:
+                self.stats.count("ladder_exhausted")
+                respond_error(r, wrapped, self.stats, "failed_internal")
+        if not retryable:
+            return
+        next_rung = (1 if flush.kind == "batch" and flush.rung == 0
+                     else 2)
+        self.stats.count(f"ladder_retry_{RUNG_NAMES[next_rung]}",
+                         len(retryable))
+        if next_rung == 1:
+            self._run_inline(Flush("batch", retryable, 1))
+        else:
+            for r in retryable:
+                self._run_request_fallback(r)
+
+    def _run_inline(self, flush: Flush) -> None:
+        """Execute one flush synchronously (the ladder's retry path —
+        no pipeline, the burst already drained); a failure descends the
+        ladder again."""
+        try:
+            n = self._collect(self._run(self._pack(flush)))
+            if n:
+                self.stats.count("ladder_recovered", n)
+        except Exception as e:  # noqa: BLE001 — ladder descends
+            self._retry_or_fail(flush, e)
+
+    def _run_request_fallback(self, req: Request) -> None:
+        """Rung 2: one request through the per-cluster device loop; the
+        last rung, so a failure re-enters the ladder at rung 2 (another
+        fallback attempt) until the budget runs out."""
+        try:
+            res = self._run_fallback(req)
+        except Exception as e:  # noqa: BLE001 — budget bounds this
+            self._retry_or_fail(Flush("fallback", [req], 2), e)
+            return
+        self._respond_ok(req, res, "fallback")
+        self.stats.count("ladder_recovered")
+
     # ---- the consumer loop (one thread) ----
+
+    def take_inflight(self) -> List[Flush]:
+        """Supervisor-side recovery: the flushes the (dead) worker was
+        executing when it crashed. Clears the slot so a double-recovery
+        cannot re-run them."""
+        flushes, self.inflight = self.inflight, []
+        return flushes
+
+    def _execute_burst(self, burst: List[Flush]) -> None:
+        self.inflight = burst
+        results = pipeline_map(
+            self._pack, self._run, self._collect, burst,
+            on_error="return", stage_hook=self._heartbeat,
+        )
+        for r in results:
+            if isinstance(r, PipelineJobError):
+                self._retry_or_fail(burst[r.job_index], r)
+        # cleared only on completion: after a mid-burst crash
+        # (BaseException) the supervisor reads it via take_inflight()
+        self.inflight = []
 
     def run_loop(self, flush_q: Queue) -> None:
         stop = False
         while not stop:
             item = flush_q.get()
+            self._heartbeat()
             if item is STOP:
                 break
+            self.busy = True
             burst: List[Flush] = [item]
             while True:
                 try:
@@ -317,13 +468,26 @@ class Worker:
                 except Empty:
                     break
                 if nxt is STOP:
+                    # run the already-collected flushes before exiting:
+                    # a shutdown must not orphan work that was queued
+                    # ahead of it
                     stop = True
                     break
                 burst.append(nxt)
-            results = pipeline_map(
-                self._pack, self._run, self._collect, burst,
-                on_error="return",
-            )
-            for r in results:
-                if isinstance(r, PipelineJobError):
-                    self._fail_flush(burst[r.job_index], r)
+            try:
+                self._execute_burst(burst)
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                # unexpected failure OUTSIDE per-job isolation (ladder
+                # bookkeeping, stats, ...): fail the burst's unresolved
+                # requests instead of dying silently with their futures
+                # hanging. BaseException (injected crash / interpreter
+                # teardown) still propagates — that is the supervisor's
+                # department.
+                self.stats.count("worker_loop_errors")
+                wrapped = self._wrap(e)
+                for f in self.take_inflight():
+                    for r in f.requests:
+                        respond_error(r, wrapped, self.stats,
+                                      "failed_internal")
+            self.busy = False
+            self._heartbeat()

@@ -1,0 +1,476 @@
+"""Supervised serving under injected faults (rifraf_tpu.serve.faults):
+the fault plan itself, the degradation ladder, worker crash recovery,
+crash-safe close(), bounded synchronous waits, and the no-hung-futures
+invariant. Fast tests stay on the per-cluster fallback path
+(batch_max_reads=1 — no batch-grid compiles); the batched-path fault
+grid and the randomized chaos mix are marked slow."""
+
+import threading
+import time
+from queue import Queue
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from rifraf_tpu.engine.driver import rifraf
+from rifraf_tpu.engine.params import RifrafParams
+from rifraf_tpu.models.errormodel import ErrorModel
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.parallel.cluster import PipelineJobError, pipeline_map
+from rifraf_tpu.serve import (
+    ConsensusServer,
+    FaultPlan,
+    InjectedFaultError,
+    ServeConfig,
+    ServerStats,
+    ServerUnhealthyError,
+    submit_many,
+)
+from rifraf_tpu.serve.faults import ENV_VAR, resolve_faults
+from rifraf_tpu.serve.request import Request
+from rifraf_tpu.serve.worker import STOP, Flush, Worker, resolve_future
+from rifraf_tpu.sim.sample import sample_sequences
+from rifraf_tpu.utils.phred import phred_to_log_p
+
+SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+
+
+def _cluster(nseqs=3, length=30, seed=0):
+    rng = np.random.default_rng(seed)
+    params = RifrafParams()
+    _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=nseqs, length=length, error_rate=0.02, rng=rng,
+        seq_errors=SEQ_ERRORS,
+    )
+    return [
+        make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                         params.bandwidth, params.scores)
+        for s, p in zip(seqs, phreds)
+    ]
+
+
+def _ref_consensus(cluster):
+    res = rifraf(
+        [r.seq for r in cluster],
+        error_log_ps=[r.error_log_p for r in cluster],
+        params=RifrafParams(batch_size=0, batch_fixed=False),
+    )
+    return res.consensus
+
+
+def _serve_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("rifraf-serve")]
+
+
+def _fast_cfg(**kw):
+    """Fallback-path config: no batch-grid compiles."""
+    kw.setdefault("batch_max_reads", 1)
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("supervise_interval_s", 0.02)
+    return ServeConfig(**kw)
+
+
+def _mk_request(cluster, cfg, rid="t0"):
+    from rifraf_tpu.parallel.sweep_sharded import bucket_key, cluster_info
+
+    info = cluster_info(cluster)
+    return Request(
+        id=rid, cluster=list(cluster), info=info,
+        key=bucket_key(info, cfg.read_bucket, cfg.band_bucket,
+                       cfg.len_bucket),
+        t_submit=time.perf_counter(), deadline=None,
+    )
+
+
+# ------------------------------------------------------------ fault plan
+
+
+def test_fault_plan_parse():
+    plan = FaultPlan.parse(
+        "dispatch:error:n=2;fetch:delay:ms=50;pack:crash:after=3,p=0.5,"
+        "seed=7"
+    )
+    d, f, p = plan.specs
+    assert (d.site, d.kind, d.n) == ("dispatch", "error", 2)
+    assert (f.kind, f.ms, f.n) == ("delay", 50.0, 1)
+    assert (p.kind, p.after, p.p, p.seed) == ("crash", 3, 0.5, 7)
+    assert bool(plan)
+    assert not FaultPlan.parse("")
+    assert not FaultPlan.parse(None)
+    with pytest.raises(ValueError):
+        FaultPlan.parse("nosite:error")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("dispatch:nokind")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("dispatch:error:bogus=1")
+
+
+def test_fault_plan_fire_counting():
+    plan = FaultPlan.parse("dispatch:error:n=2,after=1")
+    plan.fire("dispatch")  # invocation 0: skipped by after=1
+    with pytest.raises(InjectedFaultError):
+        plan.fire("dispatch")
+    with pytest.raises(InjectedFaultError):
+        plan.fire("dispatch")
+    plan.fire("dispatch")  # n=2 exhausted
+    plan.fire("fetch")  # other sites unaffected
+    snap = plan.snapshot()
+    assert snap["site_calls"] == {"dispatch": 4, "fetch": 1}
+    assert snap["specs"][0]["fired"] == 2
+
+
+def test_fault_plan_bernoulli_deterministic():
+    def fires(seed):
+        plan = FaultPlan.parse(f"fetch:error:p=0.5,n=0,seed={seed}")
+        out = []
+        for _ in range(32):
+            try:
+                plan.fire("fetch")
+                out.append(0)
+            except InjectedFaultError:
+                out.append(1)
+        return out
+
+    a, b = fires(3), fires(3)
+    assert a == b  # same seed, same schedule
+    assert 0 < sum(a) < 32  # actually probabilistic
+    assert fires(4) != a  # seed changes the schedule
+
+
+def test_fault_plan_delay_sleeps():
+    plan = FaultPlan.parse("fetch:delay:ms=40")
+    t0 = time.perf_counter()
+    plan.fire("fetch")
+    assert time.perf_counter() - t0 >= 0.04
+    t0 = time.perf_counter()
+    plan.fire("fetch")  # n=1 spent: no further delay
+    assert time.perf_counter() - t0 < 0.04
+
+
+def test_resolve_faults_env(monkeypatch):
+    plan = FaultPlan.parse("admit:error")
+    assert resolve_faults(plan) is plan
+    assert resolve_faults("admit:error").specs[0].site == "admit"
+    monkeypatch.setenv(ENV_VAR, "fetch:delay:ms=1")
+    assert resolve_faults(None).specs[0].site == "fetch"
+    monkeypatch.delenv(ENV_VAR)
+    assert not resolve_faults(None)
+    with pytest.raises(TypeError):
+        resolve_faults(42)
+
+
+# ------------------------------------------------- pipeline stage hook
+
+
+def test_pipeline_stage_hook_called_per_stage():
+    calls = []
+    out = pipeline_map(
+        lambda x: x, lambda x: x * 10, lambda x: x + 1, [1, 2],
+        stage_hook=lambda stage, i: calls.append((stage, i)),
+    )
+    assert out == [11, 21]
+    for stage in ("pack", "run", "collect"):
+        assert [(stage, 0), (stage, 1)] == [c for c in calls
+                                            if c[0] == stage]
+
+
+def test_pipeline_stage_hook_error_isolates_job():
+    def hook(stage, i):
+        if stage == "run" and i == 0:
+            raise RuntimeError("boom")
+
+    out = pipeline_map(
+        lambda x: x, lambda x: x, lambda x: x, [1, 2],
+        on_error="return", stage_hook=hook,
+    )
+    assert isinstance(out[0], PipelineJobError)
+    assert out[0].stage == "run"
+    assert out[1] == 2
+
+
+# ------------------------------------------------ future-resolution race
+
+
+def test_double_resolve_is_counted_noop():
+    stats = ServerStats()
+    cfg = _fast_cfg()
+    req = _mk_request(_cluster(), cfg)
+    from rifraf_tpu.serve.request import Response
+
+    assert resolve_future(req, Response(id="t0", ok=True), stats)
+    assert not resolve_future(req, Response(id="t0", ok=False), stats)
+    assert req.future.result().ok  # first resolver won
+    assert stats.snapshot()["counters"]["double_resolve"] == 1
+
+
+# --------------------------------------------------- worker loop hardening
+
+
+def test_run_loop_stop_mid_burst_still_runs_collected():
+    cfg = _fast_cfg(supervise=False)
+    stats = ServerStats()
+    w = Worker(cfg, stats)
+    req = _mk_request(_cluster(), cfg)
+    q = Queue()
+    q.put(Flush("fallback", [req]))
+    q.put(STOP)
+    w.run_loop(q)  # synchronous: returns at STOP
+    res = req.future.result(timeout=0)
+    assert res.ok and res.path == "fallback"
+
+
+def test_run_loop_survives_unexpected_exception():
+    cfg = _fast_cfg(supervise=False, faults="fallback:error:n=1")
+    stats = ServerStats()
+    w = Worker(cfg, stats)
+
+    def bomb(*a, **k):
+        raise RuntimeError("ladder bookkeeping exploded")
+
+    w._retry_or_fail = bomb  # escape per-job isolation on purpose
+    r1 = _mk_request(_cluster(seed=1), cfg, "r1")
+    r2 = _mk_request(_cluster(seed=2), cfg, "r2")
+    q = Queue()
+    q.put(Flush("fallback", [r1]))  # hits the injected fault -> bomb
+    q.put(Flush("fallback", [r2]))  # same burst, runs clean
+    q.put(STOP)
+    w.run_loop(q)
+    assert r2.future.result(timeout=0).ok
+    res1 = r1.future.result(timeout=0)
+    assert not res1.ok and res1.error.code == "internal"
+    c = stats.snapshot()["counters"]
+    assert c["worker_loop_errors"] == 1
+
+
+# ------------------------------------------------------- ladder (fast path)
+
+
+def test_transient_fallback_fault_recovers_bit_identical():
+    clusters = [_cluster(seed=s) for s in range(3)]
+    srv = ConsensusServer(_fast_cfg(faults="fallback:error:n=1"))
+    out = submit_many(clusters, server=srv)
+    srv.close()
+    assert all(r.ok for r in out)
+    for r, c in zip(out, clusters):
+        assert np.array_equal(r.consensus, _ref_consensus(c))
+    lad = srv.stats.ladder()
+    assert lad["retry_fallback"] >= 1 and lad["recovered"] >= 1
+
+
+def test_budget_exhaustion_fails_typed():
+    srv = ConsensusServer(_fast_cfg(faults="fallback:error:n=0",
+                                    max_retries=1))
+    out = submit_many([_cluster()], server=srv)
+    srv.close()
+    assert not out[0].ok and out[0].error.code == "internal"
+    assert srv.stats.ladder()["exhausted"] >= 1
+
+
+# ----------------------------------------------------- crash supervision
+
+
+def test_worker_crash_restart_recovers():
+    clusters = [_cluster(seed=s) for s in range(3)]
+    srv = ConsensusServer(_fast_cfg(faults="fallback:crash:n=1"))
+    out = submit_many(clusters, server=srv)
+    health = srv.health()
+    srv.close()
+    assert all(r.ok for r in out)
+    for r, c in zip(out, clusters):
+        assert np.array_equal(r.consensus, _ref_consensus(c))
+    assert health["worker_restarts"] == 1
+    assert health["worker_alive"]
+    assert not _serve_threads()  # no leaked threads after close
+
+
+def test_restart_cap_declares_unhealthy():
+    srv = ConsensusServer(_fast_cfg(faults="fallback:crash:n=0",
+                                    max_restarts=0))
+    fut = srv.submit(_cluster())
+    res = fut.result(timeout=30)
+    assert not res.ok and res.error.code == "worker_crash"
+    deadline = time.perf_counter() + 5.0
+    while not srv.health()["unhealthy"]:
+        assert time.perf_counter() < deadline
+        time.sleep(0.01)
+    with pytest.raises(ServerUnhealthyError):
+        srv.submit(_cluster())
+    srv.close()
+    assert not _serve_threads()
+
+
+def test_stall_watchdog_counts():
+    srv = ConsensusServer(_fast_cfg(
+        faults="fallback:delay:ms=400", stall_timeout_s=0.1,
+    ))
+    fut = srv.submit(_cluster())
+    assert fut.result(timeout=30).ok  # the stall clears by itself
+    counters = srv.stats.snapshot()["counters"]
+    srv.close()
+    assert counters.get("worker_stalls", 0) >= 1
+
+
+def test_batcher_crash_restarts():
+    srv = ConsensusServer(_fast_cfg())
+    try:
+        orig_due = srv._batcher.due
+        state = {"armed": True}
+
+        def due_once_broken(now):
+            if state["armed"]:
+                state["armed"] = False
+                raise RuntimeError("batcher exploded")
+            return orig_due(now)
+
+        srv._batcher.due = due_once_broken
+        # the first submit trips the bomb in the batcher loop (the
+        # fallback-kind request itself is flushed before the bomb, so
+        # it still lands); the supervisor then restarts the thread
+        out = submit_many([_cluster()], server=srv)
+        assert out[0].ok
+        deadline = time.perf_counter() + 5.0
+        while srv.health()["batcher_restarts"] < 1:
+            assert time.perf_counter() < deadline
+            time.sleep(0.01)
+        assert srv.health()["batcher_alive"]
+        # the restarted loop keeps serving
+        out2 = submit_many([_cluster(seed=9)], server=srv)
+        assert out2[0].ok
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------- admission faults
+
+
+def test_admit_fault_raises_to_caller():
+    srv = ConsensusServer(_fast_cfg(faults="admit:error:n=1"),
+                          start=False)
+    with pytest.raises(InjectedFaultError):
+        srv.submit(_cluster())
+    srv.close()
+
+
+# ---------------------------------------------------- crash-safe close
+
+
+def test_close_resolves_inflight_futures():
+    srv = ConsensusServer(_fast_cfg(faults="fallback:delay:ms=1200",
+                                    max_wait_ms=200.0))
+    futs = [srv.submit(_cluster(seed=s)) for s in range(3)]
+    t0 = time.perf_counter()
+    srv.close(timeout=0.3)
+    # the drain deadline expires while the worker sits in the injected
+    # delay: close returns promptly and every future is ALREADY
+    # resolved typed — the wedged worker finishes in the background
+    # (its late responses are double-resolve no-ops)
+    assert time.perf_counter() - t0 < 3.0
+    for f in futs:
+        res = f.result(timeout=0)  # resolved, not hung
+        assert not res.ok and res.error.code == "server_closed"
+    for t in _serve_threads():
+        t.join(timeout=30.0)
+    assert not _serve_threads()
+
+
+def test_close_unstarted_server_resolves_futures():
+    srv = ConsensusServer(_fast_cfg(), start=False)
+    fut = srv.submit(_cluster())
+    srv.close()
+    res = fut.result(timeout=0)
+    assert not res.ok and res.error.code == "server_closed"
+
+
+def test_submit_many_bounded_on_dead_worker():
+    """A dead unsupervised worker must yield typed timeout responses,
+    never hang submit_many."""
+    cfg = _fast_cfg(supervise=False, faults="fallback:crash:n=0",
+                    result_timeout_s=2.0)
+    srv = ConsensusServer(cfg)
+    t0 = time.perf_counter()
+    out = submit_many([_cluster(seed=s) for s in range(2)], server=srv)
+    wall = time.perf_counter() - t0
+    srv.close(timeout=1.0)
+    assert wall < 30.0
+    assert all(not r.ok for r in out)
+    assert {r.error.code for r in out} <= {"wait_timeout",
+                                           "worker_crash", "internal"}
+
+
+def test_snapshot_includes_health():
+    srv = ConsensusServer(_fast_cfg(faults="fetch:delay:ms=1"))
+    snap = srv.snapshot()
+    srv.close()
+    h = snap["health"]
+    assert h["healthy"] and not h["closed"]
+    assert h["batcher_alive"] and h["worker_alive"]
+    assert "retry_ladder" in h and "last_flush_age_s" in h
+    assert h["faults"]["specs"][0]["site"] == "fetch"
+    import json
+
+    json.dumps(snap)  # JSON-serializable as exported
+
+
+# --------------------------------------------- batched-path grid (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("site", ["pack", "compile", "dispatch", "fetch"])
+def test_batched_fault_grid_recovers_bit_identical(site):
+    """A transient fault at each batched-path site: the ladder re-runs
+    the micro-batch one rung down, every future resolves, and recovered
+    results equal the unfaulted reference bit for bit."""
+    clusters = [_cluster(seed=s) for s in range(4)]
+    srv = ConsensusServer(ServeConfig(max_wait_ms=10.0,
+                                      faults=f"{site}:error:n=1"))
+    out = submit_many(clusters, server=srv)
+    srv.close()
+    assert all(r.ok for r in out)
+    for r, c in zip(out, clusters):
+        assert np.array_equal(r.consensus, _ref_consensus(c))
+    lad = srv.stats.ladder()
+    assert lad.get("retry_block", 0) + lad.get("retry_fallback", 0) >= 1
+    assert not _serve_threads()
+
+
+@pytest.mark.slow
+def test_batched_double_fault_descends_to_fallback():
+    """Two consecutive dispatch faults exhaust rungs 0 and 1; rung 2
+    (per-request fallback) still recovers bit-identically."""
+    clusters = [_cluster(seed=s) for s in range(4)]
+    srv = ConsensusServer(ServeConfig(max_wait_ms=10.0,
+                                      faults="dispatch:error:n=2"))
+    out = submit_many(clusters, server=srv)
+    srv.close()
+    assert all(r.ok for r in out)
+    for r, c in zip(out, clusters):
+        assert np.array_equal(r.consensus, _ref_consensus(c))
+    lad = srv.stats.ladder()
+    assert lad["retry_block"] >= 1
+    assert lad["retry_fallback"] >= 1
+    assert lad["recovered"] >= len(clusters)
+
+
+@pytest.mark.slow
+def test_randomized_chaos_every_future_resolves():
+    """Seeded Bernoulli faults across several sites at once: every
+    request resolves (ok or typed), successes stay bit-identical, and
+    no serve thread outlives close()."""
+    clusters = [_cluster(seed=s) for s in range(8)]
+    faults = ("pack:error:p=0.3,n=0,seed=5;"
+              "dispatch:error:p=0.3,n=0,seed=6;"
+              "fetch:delay:ms=10,p=0.5,n=0,seed=7")
+    srv = ConsensusServer(ServeConfig(max_wait_ms=10.0, faults=faults,
+                                      result_timeout_s=120.0))
+    out = submit_many(clusters, server=srv)
+    srv.close()
+    assert len(out) == len(clusters)
+    for r, c in zip(out, clusters):
+        assert r.ok or r.error is not None  # typed, always
+        if r.ok:
+            assert np.array_equal(r.consensus, _ref_consensus(c))
+    assert not _serve_threads()

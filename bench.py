@@ -52,6 +52,13 @@ Other modes (results appended to BASELINE.md, not the driver JSON):
                availability, p99, and restart counts (--serve-n
                overrides the request count for smoke runs; slow-only
                in CI)
+  --multichip  mesh scale-out: the north-star consensus with its read
+               axis sharded over 1/2/4/8-device meshes (wall, identity
+               vs the unsharded oracle, modeled ICI-aware efficiency)
+               plus the per-device executor fleet's requests/sec/chip
+               on a heterogeneous stream; prints one "MULTICHIP {...}"
+               JSON line (--multichip-reads/-len/-timed/-serve-n
+               override for smoke runs)
   --quick      headline only (skip the north-star / ref-default extras)
 """
 
@@ -694,6 +701,139 @@ def _serve_mode():
     print(json.dumps(out))
 
 
+def _multichip_arg(flag, default):
+    if flag in sys.argv:
+        return int(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
+def _multichip_mode():
+    """Read-axis scaling + fleet throughput across the available devices.
+
+    Two measurements, one MULTICHIP JSON line:
+
+    1. ONE north-star-scale consensus (2048 x 1 kb, full batch) with its
+       read axis sharded over 1/2/4/8-device meshes
+       (parallel.sharding.mesh_fused_step_pallas under the driver) —
+       wall time, speedup vs the 1-device run, consensus bit-identity
+       against the unsharded oracle, and the utils.roofline
+       mesh_fused_model prediction (per-device HBM bytes + the ICI
+       collective term) next to each measured point;
+    2. the device-parallel FLEET (sweep_clusters_sharded n_workers — one
+       pinned executor per device) on a heterogeneous serving workload:
+       requests/sec and requests/sec/chip per fleet size.
+
+    Device counts are capped by ``len(jax.devices())`` — run under
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual
+    curve (identity still meaningful; the walls then share one host's
+    cores and measure overhead, not scaling). Smoke overrides:
+    --multichip-reads N, --multichip-len N, --multichip-timed N,
+    --multichip-serve-n N.
+    """
+    import jax
+
+    from rifraf_tpu.engine.driver import rifraf
+    from rifraf_tpu.engine.params import RifrafParams
+    from rifraf_tpu.parallel.sharding import make_mesh
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+    from rifraf_tpu.utils import roofline as _roofline
+
+    n_reads = _multichip_arg("--multichip-reads", 2048)
+    tlen = _multichip_arg("--multichip-len", 1000)
+    n_timed = _multichip_arg("--multichip-timed", 2)
+    serve_n = _multichip_arg("--multichip-serve-n", 256)
+
+    n_dev = len(jax.devices())
+    counts = [k for k in (1, 2, 4, 8) if k <= n_dev]
+    template, seqs, phreds = build_e2e_problem(tlen, n_reads)
+
+    def one(mesh):
+        params = RifrafParams(batch_size=0, batch_fixed=False,
+                              do_alignment_proposals=False, mesh=mesh)
+        walls = []
+        result = None
+        for i in range(n_timed + 1):  # first run compiles
+            t0 = time.perf_counter()
+            result = rifraf(seqs, phreds=phreds, params=params)
+            if i > 0:
+                walls.append(time.perf_counter() - t0)
+        return walls, result
+
+    out = {
+        "config": f"multichip_{n_reads}x{tlen}",
+        "backend": jax.default_backend(),
+        "n_devices_visible": n_dev,
+        "n_reads": n_reads,
+        "tlen": tlen,
+    }
+
+    _roofline.clear()
+    scaling = []
+    base_wall = None
+    oracle = None
+    for k in counts:
+        mesh = make_mesh(k) if k > 1 else None
+        walls, result = one(mesh)
+        wall = min(walls)
+        if k == 1:
+            base_wall, oracle = wall, result
+        entry = {
+            "devices": k,
+            "wall_s": round(wall, 3),
+            "runs_s": [round(w, 3) for w in walls],
+            "speedup_vs_1dev": round(base_wall / wall, 2),
+            "scaling_efficiency": round(base_wall / wall / k, 3),
+            "identical_to_1dev": bool(np.array_equal(
+                result.consensus, oracle.consensus)),
+        }
+        recs = [r for r in _roofline.snapshot()
+                if r["kernel"] == "mesh_fused_step"
+                and r["n_devices"] == k]
+        if recs:
+            r = recs[-1]
+            entry["model"] = {
+                "bytes_per_device_gb": round(
+                    r["model_bytes_per_device"] / 1e9, 3),
+                "ici_bytes_per_device": r["ici_bytes_per_device"],
+                "speedup": round(r["model_speedup"], 2),
+                "scaling_efficiency": round(r["scaling_efficiency"], 3),
+            }
+        scaling.append(entry)
+    out["read_axis_scaling"] = scaling
+    out["identity"] = ("ok" if all(e["identical_to_1dev"]
+                                   for e in scaling) else "MISMATCH")
+
+    # fleet: one pinned executor per device on a heterogeneous request
+    # stream — throughput must scale with chips because the problems are
+    # independent (the embarrassingly parallel regime the read-axis mesh
+    # complements)
+    rng = np.random.default_rng(21)
+    clusters = _serve_workload(serve_n, rng)
+    fleet = []
+    fleet_oracle = None
+    for k in counts:
+        sweep_clusters_sharded(clusters, n_workers=k)  # warm compiles
+        t0 = time.perf_counter()
+        res = sweep_clusters_sharded(clusters, n_workers=k)
+        wall = time.perf_counter() - t0
+        if k == 1:
+            fleet_oracle = res
+        rps = serve_n / wall
+        fleet.append({
+            "workers": k,
+            "wall_s": round(wall, 3),
+            "rps": round(rps, 2),
+            "rps_per_chip": round(rps / k, 2),
+            "identical_to_1worker": all(
+                np.array_equal(a.consensus, b.consensus)
+                and a.score == b.score
+                for a, b in zip(res, fleet_oracle)
+            ),
+        })
+    out["fleet"] = {"n_requests": serve_n, "scaling": fleet}
+    print("MULTICHIP " + json.dumps(out))
+
+
 def main():
     if "--cpu" in sys.argv:
         import os
@@ -724,6 +864,9 @@ def main():
         return 0
     if "--serve" in sys.argv:
         _serve_mode()
+        return 0
+    if "--multichip" in sys.argv:
+        _multichip_mode()
         return 0
     if "--refdefault" in sys.argv:
         # standalone ref-default measurement (use with --cpu to

@@ -88,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="micro-batch latency flush threshold")
     p.add_argument("--max-queue", type=int, default=256,
                    help="bounded admission queue size (backpressure)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="device-parallel fleet size: this many worker "
+                        "threads share the flush queue, each pinned to "
+                        "one device round-robin over jax.devices() "
+                        "(compiled programs and the persistent cache "
+                        "are shared, so the bucket grid warms once)")
     p.add_argument("--deadline-ms", type=float, default=0.0,
                    help="default per-request deadline applied to requests "
                         "without their own (0 = none)")
@@ -113,6 +119,7 @@ def config_from_args(args) -> ServeConfig:
         max_queue=args.max_queue,
         max_iters=args.max_iters,
         do_alignment_proposals=args.alignment_proposals,
+        n_workers=max(1, args.workers),
     )
     if args.seq_errors:
         kw["scores"] = parse_error_model(args.seq_errors)

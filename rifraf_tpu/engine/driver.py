@@ -158,6 +158,38 @@ def _enable_compilation_cache() -> None:
         pass
 
 
+def recover_stale_cache(err) -> bool:
+    """Stale-persistent-cache recovery (the MULTICHIP_r01/r02 failure:
+    a libtpu upgrade left AOT entries the new client refuses with
+    ``FAILED_PRECONDITION: libtpu version mismatch``). If ``err``
+    carries that signature, drop the configured cache dir's entries and
+    disable the cache for the rest of the process so the caller can
+    retry the failed dispatch against a fresh compile. Returns whether
+    recovery ran — False means the error is something else and must
+    propagate."""
+    global _cache_enabled
+    from ..utils.cachedir import clear_cache_dir, is_stale_cache_error
+
+    if not is_stale_cache_error(err):
+        return False
+    try:
+        import jax
+
+        cache_dir = jax.config.jax_compilation_cache_dir
+        n = clear_cache_dir(cache_dir)
+        jax.config.update("jax_enable_compilation_cache", False)
+        sys.stderr.write(
+            f"rifraf-tpu: stale persistent compilation cache detected "
+            f"({err!r}); dropped {n} entries from {cache_dir!r} and "
+            "disabled the cache for this process\n"
+        )
+    except Exception:
+        return False
+    # keep _enable_compilation_cache from re-pointing jax at the dir
+    _cache_enabled = True
+    return True
+
+
 def initial_state(
     consensus: Optional[np.ndarray],
     sequences: List[ReadScores],

@@ -423,8 +423,11 @@ class BatchAligner:
         T1 = T + 1
         T1p = _bucket(T1, 64)
         K = self._pallas_K(tlen)
-        # the mesh path shards its own 3-launch pipeline; route it split
-        impl = "split" if self.mesh is not None else select_impl(
+        # one routing decision for both layouts: under a mesh each shard
+        # runs the same single-launch megakernel on its local lanes
+        # (shard_map + psum epilogue), so eligibility is identical to
+        # the single-device call — want_moves still pins split
+        impl = select_impl(
             T1p, K, want_stats=want_stats, want_moves=want_moves)[0]
         C = _dense_cols(T1p, K, _bucket(self.batch.n_reads, 128),
                         want_stats=want_stats, impl=impl,
@@ -442,10 +445,24 @@ class BatchAligner:
                     self._weights_dev.astype(jnp.float32),
                     K, T1p, C,
                     want_stats=want_stats, want_moves=want_moves,
-                    interpret=_pallas_interpret(),
+                    interpret=_pallas_interpret(), impl=impl,
                 )
-            _, _, Npad = self._mesh_npads()
+            _, Npad_local, Npad = self._mesh_npads()
             slots = self._mesh_read_slots(self.batch.n_reads)
+            from ..utils import roofline
+
+            n_dev = self.mesh.devices.size
+            mm = roofline.mesh_fused_model(
+                T1p, K, Npad_local, C, n_dev,
+                want_stats=want_stats, impl=impl)
+            roofline.record(
+                "mesh_fused_step", T1p=T1p, K=K, Npad_local=Npad_local,
+                C=C, impl=impl, n_devices=n_dev, want_stats=want_stats,
+                model_bytes_per_device=mm["bytes_per_device"],
+                ici_bytes_per_device=mm["ici_bytes_per_device"],
+                model_speedup=mm["model_speedup"],
+                scaling_efficiency=mm["scaling_efficiency"],
+            )
         else:
             geom = align_jax.batch_geometry(batch, tlen)
             weights = jnp.ones(self.batch.n_reads, dtype=jnp.float32)

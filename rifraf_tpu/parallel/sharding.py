@@ -32,21 +32,9 @@ from ..models.sequences import ReadBatch
 from ..ops import align_jax
 from ..ops.align_jax import BandGeometry
 from ..ops.proposal_jax import _score_one_read
+from ..utils.meshutil import shard_map_compat as _shard_map
 
 READS_AXIS = "reads"
-
-
-def _shard_map(*args, **kwargs):
-    """jax.shard_map across the API migration: older releases keep it in
-    jax.experimental.shard_map and call the varying-axes check check_rep
-    instead of check_vma."""
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-        if "check_vma" in kwargs:
-            kwargs["check_rep"] = kwargs.pop("check_vma")
-    return shard_map(*args, **kwargs)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = READS_AXIS) -> Mesh:
@@ -179,7 +167,7 @@ def mesh_fill_buffers(mesh: Mesh, batch: ReadBatch, Npad_local: int):
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "K", "T1p", "C", "want_stats",
-                     "want_moves", "interpret"),
+                     "want_moves", "interpret", "impl"),
 )
 def mesh_fused_step_pallas(
     mesh: Mesh,
@@ -195,14 +183,22 @@ def mesh_fused_step_pallas(
     want_stats: bool = False,
     want_moves: bool = False,
     interpret: bool = False,
+    impl=None,
 ):
     """The Pallas fused step over a read-sharded mesh: per-shard on-core
     fill + dense tables, cross-shard psum/pmax reductions. Returns
     (packed, moves-or-None); packed follows pack_layout_pallas with
     Npad = n_devices * Npad_local (per-shard lane padding preserved —
-    map read r to slot (r // Nlocal) * Npad_local + r % Nlocal)."""
+    map read r to slot (r // Nlocal) * Npad_local + r % Nlocal).
 
-    from ..ops.dense_pallas import fused_tables_pallas
+    ``impl`` is the fused-step routing ("mega"/"split") resolved by the
+    CALLER via ops.fused_pallas.select_impl — a static argname here, so
+    it must be decided outside this jit (same discipline as the
+    single-device dispatchers: the env selector never reads inside a
+    trace). Each shard runs the SINGLE-LAUNCH megakernel on its local
+    lanes when eligible; only the psum/pmax epilogue crosses chips."""
+
+    from ..ops.fused_pallas import fused_tables_auto
 
     def local(t, tl, bufs_l, lens_l, bw_l, w_l):
         from ..ops.dense_pallas import pack_parts
@@ -210,14 +206,20 @@ def mesh_fused_step_pallas(
         geom = BandGeometry.make(lens_l, tl, bw_l)
         OFF_g = jax.lax.pmax(jnp.max(geom.offset), READS_AXIS)
         sl = bufs_l.lengths
+        # the split path's backward-halo rolls need ONE slen_min base
+        # across shards (any shared base is self-consistent; a per-shard
+        # minimum is not). The megakernel bakes the mirroring at write
+        # time and ignores it.
         slen_min_g = jax.lax.pmin(
             jnp.min(jnp.where(sl > 0, sl, jnp.int32(2**30))), READS_AXIS
         )
-        out = fused_tables_pallas(
+        out = fused_tables_auto(
             t, tl, bufs_l, geom, w_l, K, T1p, C,
             want_stats=want_stats, want_moves=want_moves,
             off_override=OFF_g, slen_min=slen_min_g, interpret=interpret,
+            impl=impl,
         )
+        out.pop("impl", None)
         # cross-shard reductions, then the SHARED section order
         out = dict(
             out,
@@ -304,6 +306,82 @@ def mesh_fill_stats_pallas(
     )
     scores, nerr = fn(template, tlen, bufs, lengths, bandwidths)
     return jnp.concatenate([scores, nerr])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "K", "n_seg", "want_stats", "want_tables"),
+)
+def mesh_fused_step_segmented(
+    mesh: Mesh,
+    templates,  # [S, Tmax] int8 (replicated; one template per segment)
+    tlens,  # [S] int32 (replicated)
+    seg_ids,  # [Nglobal] int32, read-sharded (lane -> segment slot)
+    seq,  # [Nglobal, L] int8, read-sharded
+    match,
+    mismatch,
+    ins,
+    dels,  # [Nglobal, L + 1]
+    lengths,  # [Nglobal] int32, read-sharded
+    bandwidths,  # [Nglobal] int32, read-sharded
+    weights,  # [Nglobal] f32, read-sharded ({0,1} padding mask)
+    K: int,
+    n_seg: int,
+    want_stats: bool = False,
+    want_tables: bool = True,
+):
+    """ops.fused.fused_step_segmented over a read-sharded mesh: each
+    device runs the segment-packed fused step on its local lane slice
+    (the per-lane fills were already independent; packing changes
+    nothing), and every SEGMENT-MASKED reduction finishes with a
+    cross-shard collective — ``psum`` for the per-segment totals and
+    dense edit tables, ``pmax`` for the edits-indicator union. Per-lane
+    outputs (``scores``, ``n_errors``) keep their read sharding.
+
+    The global lane count must divide the mesh size; pad with
+    weight-0 lanes that DUPLICATE a real read of their assigned segment
+    (the same padding convention as the single-device packer —
+    ChunkExecutor.pack_seg). Same dict contract as the unsharded step.
+    """
+
+    from ..ops.fused import fused_step_segmented
+
+    def local(tpl, tl, sg_l, sq_l, mt_l, mm_l, in_l, dl_l, ln_l, bw_l,
+              w_l):
+        out = fused_step_segmented(
+            tpl, tl, sg_l, sq_l, mt_l, mm_l, in_l, dl_l, ln_l, bw_l,
+            w_l, K, n_seg,
+            want_stats=want_stats, want_tables=want_tables,
+        )
+        out = dict(
+            out,
+            total=jax.lax.psum(out["total"], READS_AXIS),
+            sub=jax.lax.psum(out["sub"], READS_AXIS),
+            ins=jax.lax.psum(out["ins"], READS_AXIS),
+            **{"del": jax.lax.psum(out["del"], READS_AXIS)},
+        )
+        if want_stats:
+            out["edits"] = jax.lax.pmax(out["edits"], READS_AXIS)
+        return out
+
+    rep = P()
+    shard = P(READS_AXIS)
+    out_specs = {
+        "total": rep, "scores": shard,
+        "sub": rep, "ins": rep, "del": rep,
+    }
+    if want_stats:
+        out_specs.update({"n_errors": shard, "edits": rep})
+    fn = _shard_map(
+        local, mesh=mesh,
+        in_specs=(rep, rep) + (shard,) * 9,
+        out_specs=out_specs,
+        # the collectives above establish the replication invariants;
+        # see mesh_fused_step_pallas
+        check_vma=False,
+    )
+    return fn(templates, tlens, seg_ids, seq, match, mismatch, ins,
+              dels, lengths, bandwidths, weights)
 
 
 def sharded_consensus_step(

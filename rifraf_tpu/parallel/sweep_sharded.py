@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
@@ -634,12 +635,15 @@ class ChunkExecutor:
 
     def __init__(self, mesh=None, max_iters: int = 100, min_dist: int = 15,
                  bandwidth_pvalue: float = 0.1,
-                 do_alignment_proposals: bool = False):
+                 do_alignment_proposals: bool = False, device=None):
         import jax
 
         from ..engine.params import resolve_dtype
 
+        if mesh is not None and device is not None:
+            raise ValueError("pass mesh OR device, not both")
         self.mesh = mesh
+        self.device = device
         self.max_iters = max_iters
         self.H = max_iters + 1
         self.min_dist = min_dist
@@ -649,11 +653,19 @@ class ChunkExecutor:
         self.donate = jax.default_backend() != "cpu"
 
     def _shard(self, a, *spec):
+        """Device placement of one input array: sharded over the mesh
+        axis, pinned to ``device`` (fleet mode — jit follows committed
+        argument placement, so every executor of a fleet shares ONE
+        trace/lowering via the module-level lru-cached program factories
+        and the persistent compilation cache, but runs its own per-device
+        executable), or the default device."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
 
         if self.mesh is None:
+            if self.device is not None:
+                return jax.device_put(a, self.device)
             return jnp.asarray(a)
         return jax.device_put(
             a,
@@ -996,6 +1008,7 @@ def sweep_clusters_sharded(
     lane_target: int = LANE_TARGET,
     segment_pack: Optional[bool] = None,
     segment_align: int = 1,
+    n_workers: int = 1,
 ):
     """One consensus per cluster, all clusters in one device program.
 
@@ -1016,6 +1029,16 @@ def sweep_clusters_sharded(
     small clusters into shared lane blocks (see plan_sweep; default
     follows the ``RIFRAF_TPU_SEGMENT_PACK`` env gate). Results are
     bit-identical either way (tests/test_lane_packing.py).
+    ``n_workers`` > 1 runs a device-parallel FLEET instead of a mesh:
+    one ChunkExecutor pinned per device (round-robin over
+    ``jax.devices()``), chunks dealt round-robin across them, each
+    worker running its own pack→run→collect pipeline on a thread.
+    Because jit follows committed argument placement, the fleet shares
+    one trace per bucket signature (the module-level lru-cached program
+    factories) and one fingerprinted persistent compilation cache — the
+    bucket grid warms once per fleet, not once per worker. Mutually
+    exclusive with ``mesh`` (a mesh shards ONE program over devices;
+    the fleet runs independent programs per device).
 
     Returns the per-cluster results IN INPUT ORDER; with
     ``return_stats`` also a SweepStats (per-bucket occupancy, padding
@@ -1036,11 +1059,27 @@ def sweep_clusters_sharded(
         stats = SweepStats(0, 0, 0, 0, 0, 0.0, 0, 0.0, [])
         return ([], stats) if return_stats else []
 
-    executor = ChunkExecutor(
-        mesh=mesh, max_iters=max_iters, min_dist=min_dist,
-        bandwidth_pvalue=bandwidth_pvalue,
-        do_alignment_proposals=do_alignment_proposals,
-    )
+    if n_workers > 1 and mesh is not None:
+        raise ValueError("n_workers > 1 is the per-device fleet; "
+                         "pass mesh OR n_workers, not both")
+    if n_workers > 1:
+        import jax
+
+        devs = jax.devices()
+        executors = [
+            ChunkExecutor(
+                device=devs[i % len(devs)], max_iters=max_iters,
+                min_dist=min_dist, bandwidth_pvalue=bandwidth_pvalue,
+                do_alignment_proposals=do_alignment_proposals,
+            )
+            for i in range(n_workers)
+        ]
+    else:
+        executors = [ChunkExecutor(
+            mesh=mesh, max_iters=max_iters, min_dist=min_dist,
+            bandwidth_pvalue=bandwidth_pvalue,
+            do_alignment_proposals=do_alignment_proposals,
+        )]
 
     tasks = [
         (bi, plan, chunk)
@@ -1048,34 +1087,72 @@ def sweep_clusters_sharded(
         for chunk in plan.chunks
     ]
     bucket_seconds = [0.0] * len(plans)
+    seconds_lock = threading.Lock()
     out: List[Optional[SweepResult]] = [None] * G
 
-    def pack(task):
-        bi, plan, idxs = task
-        if isinstance(plan, SegmentBucketPlan):
-            return bi, True, executor.pack_seg(plan, idxs, clusters, infos)
-        return bi, False, executor.pack(plan, idxs, clusters, infos)
+    def make_stages(executor):
+        # one pack/run/collect triple per fleet worker; `out` writes are
+        # index-addressed and chunk-disjoint so only the per-bucket
+        # timing accumulator needs the lock
+        def pack(task):
+            bi, plan, idxs = task
+            if isinstance(plan, SegmentBucketPlan):
+                return bi, True, executor.pack_seg(
+                    plan, idxs, clusters, infos)
+            return bi, False, executor.pack(plan, idxs, clusters, infos)
 
-    def run(arg):
-        bi, seg, packed = arg
-        t0 = time.perf_counter()
-        handle = executor.run_seg(packed) if seg else executor.run(packed)
-        bucket_seconds[bi] += time.perf_counter() - t0
-        return bi, seg, handle
+        def run(arg):
+            bi, seg, packed = arg
+            t0 = time.perf_counter()
+            handle = (executor.run_seg(packed) if seg
+                      else executor.run(packed))
+            with seconds_lock:
+                bucket_seconds[bi] += time.perf_counter() - t0
+            return bi, seg, handle
 
-    def collect(arg):
-        bi, seg, handle = arg
-        t0 = time.perf_counter()
-        if seg:
-            for ci, r in executor.collect_seg(handle):
-                out[ci] = r
-        else:
-            results = executor.collect(handle)
-            for ci, r in zip(handle[2], results):
-                out[ci] = r
-        bucket_seconds[bi] += time.perf_counter() - t0
+        def collect(arg):
+            bi, seg, handle = arg
+            t0 = time.perf_counter()
+            if seg:
+                for ci, r in executor.collect_seg(handle):
+                    out[ci] = r
+            else:
+                results = executor.collect(handle)
+                for ci, r in zip(handle[2], results):
+                    out[ci] = r
+            with seconds_lock:
+                bucket_seconds[bi] += time.perf_counter() - t0
 
-    pipeline_map(pack, run, collect, tasks)
+        return pack, run, collect
+
+    if len(executors) == 1:
+        pack, run, collect = make_stages(executors[0])
+        pipeline_map(pack, run, collect, tasks)
+    else:
+        # deal chunks round-robin across the fleet; each worker drives
+        # its own double-buffered pipeline on its own thread. The
+        # lru-cached program factories hand every worker the SAME jit
+        # wrapper per bucket signature, so a signature traces once and
+        # per-device executables come out of one (persistent,
+        # fingerprinted) compilation cache — the grid warms once per
+        # fleet, not once per worker.
+        shards = [tasks[w::len(executors)] for w in range(len(executors))]
+
+        def drive(w):
+            pack, run, collect = make_stages(executors[w])
+            pipeline_map(pack, run, collect, shards[w])
+
+        threads = [
+            threading.Thread(target=drive, args=(w,), daemon=True)
+            for w in range(1, len(executors))
+            if shards[w]
+        ]
+        for th in threads:
+            th.start()
+        if shards[0]:
+            drive(0)
+        for th in threads:
+            th.join()
 
     if not return_stats:
         return list(out)

@@ -120,6 +120,14 @@ class ServeConfig:
     bandwidth: int = 3 * CODON_LENGTH
     # optional Mesh whose first axis shards the micro-batch cluster axis
     mesh: Optional[object] = None
+    # device-parallel FLEET: this many worker threads share the flush
+    # queue, each with its own ChunkExecutor pinned to one device
+    # (round-robin over jax.devices()). The lru-cached program factories
+    # and the fingerprinted persistent compilation cache are shared, so
+    # the bucket grid warms once per fleet. Mutually exclusive with
+    # ``mesh`` (shard ONE program over devices, or run one program PER
+    # device — not both)
+    n_workers: int = 1
 
 
 def encode_cluster(

@@ -79,7 +79,15 @@ class ConsensusServer:
         self._admit_q: Queue = Queue(maxsize=self.config.max_queue)
         self._flush_q: Queue = Queue()
         self._batcher = MicroBatcher(self.config)
-        self._worker = Worker(self.config, self.stats, self.faults)
+        if self.config.n_workers > 1 and self.config.mesh is not None:
+            raise ValueError(
+                "n_workers > 1 is the per-device fleet; configure mesh "
+                "OR n_workers, not both"
+            )
+        self._workers: List[Worker] = [
+            self._make_worker(i) for i in range(
+                max(1, self.config.n_workers))
+        ]
         self._ids = itertools.count()
         self._closed = False
         self._unhealthy = False
@@ -90,22 +98,54 @@ class ConsensusServer:
         self._outstanding: Dict[int, Request] = {}
         self._outstanding_lock = threading.Lock()
         self._batcher_thread: Optional[threading.Thread] = None
-        self._worker_thread: Optional[threading.Thread] = None
+        self._worker_threads: List[Optional[threading.Thread]] = [
+            None
+        ] * len(self._workers)
         self._supervisor_thread: Optional[threading.Thread] = None
         self._stop_supervisor = threading.Event()
         self._worker_restarts = 0
         self._batcher_restarts = 0
-        self._last_stall_beat: Optional[float] = None
+        self._last_stall_beat: Dict[int, float] = {}
         if start:
             self.start()
 
     # ---- lifecycle ----
 
+    def _make_worker(self, i: int) -> Worker:
+        """One worker of the (possibly single-member) fleet: beyond one
+        worker, each executor pins its arrays to one device — round-robin
+        over ``jax.devices()`` — and bursts are capped so the shared
+        flush queue feeds the whole fleet instead of whichever worker
+        woke first. The program factories are module-level lru caches
+        and the persistent compilation cache is fingerprint-shared, so N
+        workers still warm each bucket signature once."""
+        cfg = self.config
+        device = None
+        burst_limit = None
+        if cfg.n_workers > 1:
+            import jax
+
+            devs = jax.devices()
+            device = devs[i % len(devs)]
+            # keep enough drained flushes to double-buffer (pack k+1
+            # overlaps run k) without starving the other workers
+            burst_limit = 2
+        return Worker(cfg, self.stats, self.faults, device=device,
+                      burst_limit=burst_limit)
+
+    @property
+    def _worker(self) -> Worker:
+        # single-worker accessor (warmup, tests); worker 0 is the
+        # fleet's representative — every worker shares its stats object
+        # and program factories
+        return self._workers[0]
+
     def start(self) -> "ConsensusServer":
         if self._batcher_thread is not None:
             return self
         self._batcher_thread = self._spawn_batcher()
-        self._worker_thread = self._spawn_worker()
+        for i in range(len(self._workers)):
+            self._worker_threads[i] = self._spawn_worker(i)
         if self.config.supervise:
             st = threading.Thread(target=self._supervise_loop,
                                   daemon=True,
@@ -120,10 +160,10 @@ class ConsensusServer:
         bt.start()
         return bt
 
-    def _spawn_worker(self) -> threading.Thread:
-        wt = threading.Thread(target=self._worker.run_loop,
+    def _spawn_worker(self, i: int = 0) -> threading.Thread:
+        wt = threading.Thread(target=self._workers[i].run_loop,
                               args=(self._flush_q,), daemon=True,
-                              name="rifraf-serve-worker")
+                              name=f"rifraf-serve-worker-{i}")
         wt.start()
         return wt
 
@@ -158,8 +198,13 @@ class ConsensusServer:
         if self._batcher_thread is not None:
             self._admit_q.put(_SHUTDOWN)
             self._batcher_thread.join(remaining())
-            self._flush_q.put(STOP)
-            self._worker_thread.join(remaining())
+            # one STOP per worker: each sentinel terminates exactly one
+            # consumer of the shared flush queue
+            for _ in self._workers:
+                self._flush_q.put(STOP)
+            for wt in self._worker_threads:
+                if wt is not None:
+                    wt.join(remaining())
         # the no-hung-futures invariant: anything still unresolved —
         # deadline expired mid-drain, worker dead, never started —
         # resolves typed right now
@@ -333,8 +378,12 @@ class ConsensusServer:
         self._batcher_thread = self._spawn_batcher()
 
     def _check_worker(self) -> None:
-        wt = self._worker_thread
-        w = self._worker
+        for i in range(len(self._workers)):
+            self._check_worker_slot(i)
+
+    def _check_worker_slot(self, i: int) -> None:
+        wt = self._worker_threads[i]
+        w = self._workers[i]
         if wt is not None and wt.is_alive():
             # alive: watch for a stall (busy with no heartbeat). One
             # count per stalled burst — last_beat only moves when the
@@ -342,11 +391,13 @@ class ConsensusServer:
             if w.busy:
                 age = time.perf_counter() - w.last_beat
                 if (age > self.config.stall_timeout_s
-                        and w.last_beat != self._last_stall_beat):
-                    self._last_stall_beat = w.last_beat
+                        and w.last_beat != self._last_stall_beat.get(i)):
+                    self._last_stall_beat[i] = w.last_beat
                     self.stats.count("worker_stalls")
             return
-        # dead worker: the crash escaped every except-Exception layer
+        # dead worker: the crash escaped every except-Exception layer.
+        # The restart budget is FLEET-WIDE — a crash loop on any device
+        # exhausts it, exactly like the single-worker server.
         self.stats.count("worker_crashes")
         crashed = w.take_inflight()
         if self._worker_restarts >= self.config.max_restarts:
@@ -359,8 +410,8 @@ class ConsensusServer:
         self.stats.count("worker_restarts")
         # a fresh Worker re-attaches to the module-level lru-cached
         # program factories: no recompilation, same executables
-        self._worker = Worker(self.config, self.stats, self.faults)
-        self._worker_thread = self._spawn_worker()
+        self._workers[i] = self._make_worker(i)
+        self._worker_threads[i] = self._spawn_worker(i)
         self._requeue_crashed(crashed)
 
     def _backoff(self, k: int) -> None:
@@ -467,22 +518,39 @@ class ConsensusServer:
         liveness, worker heartbeat age, restart and stall counts, the
         retry-ladder counters, outstanding-request count, and the
         fault plan's fire accounting when faults are configured."""
-        bt, wt = self._batcher_thread, self._worker_thread
-        w = self._worker
+        bt = self._batcher_thread
         now = time.perf_counter()
+        alive = [bool(wt is not None and wt.is_alive())
+                 for wt in self._worker_threads]
         out = {
             "healthy": not (self._unhealthy or self._closed),
             "closed": self._closed,
             "unhealthy": self._unhealthy,
             "batcher_alive": bool(bt is not None and bt.is_alive()),
-            "worker_alive": bool(wt is not None and wt.is_alive()),
-            "worker_busy": w.busy,
-            "last_flush_age_s": round(now - w.last_beat, 3),
+            # fleet semantics: alive means EVERY worker thread is
+            # running; busy means any of them is; the flush age is the
+            # freshest heartbeat (per-worker detail in "workers")
+            "worker_alive": all(alive),
+            "worker_busy": any(w.busy for w in self._workers),
+            "last_flush_age_s": round(
+                now - max(w.last_beat for w in self._workers), 3),
+            "n_workers": len(self._workers),
             "worker_restarts": self._worker_restarts,
             "batcher_restarts": self._batcher_restarts,
             "retry_ladder": self.stats.ladder(),
             "outstanding": len(self._outstanding),
         }
+        if len(self._workers) > 1:
+            out["workers"] = [
+                {
+                    "alive": alive[i],
+                    "busy": w.busy,
+                    "last_flush_age_s": round(now - w.last_beat, 3),
+                    "device": str(w.device) if w.device is not None
+                    else None,
+                }
+                for i, w in enumerate(self._workers)
+            ]
         if self.faults:
             out["faults"] = self.faults.snapshot()
         return out

@@ -54,8 +54,9 @@ from ..parallel.sweep_sharded import (
     SweepResult,
     _lane_slots,
 )
+from ..utils.meshutil import mesh_axis_size, mesh_round
 from ..utils.shapes import bucket as _bucket
-from ..utils.shapes import pack_segments, pow2_bucket
+from ..utils.shapes import pack_segments
 from .batcher import resolve_segment_pack, segment_eligible
 from .errors import DeadlineExceededError, ServeError
 from .faults import FaultPlan, resolve_faults
@@ -130,19 +131,27 @@ class Worker:
     """Owns the ChunkExecutor and the flush-queue consumer loop."""
 
     def __init__(self, config: ServeConfig, stats: ServerStats,
-                 faults: Optional[FaultPlan] = None):
+                 faults: Optional[FaultPlan] = None, device=None,
+                 burst_limit: Optional[int] = None):
         self.config = config
         self.stats = stats
         self.faults = faults if faults is not None else resolve_faults(
             config.faults
         )
         self.segment_pack = resolve_segment_pack(config)
+        # fleet mode: each worker's executor pins its arrays to ONE
+        # device (jit then runs there), and bursts are capped so one
+        # worker cannot drain the shared flush queue while its fleet
+        # mates idle
+        self.device = device
+        self.burst_limit = burst_limit
         self.executor = ChunkExecutor(
             mesh=config.mesh,
             max_iters=config.max_iters,
             min_dist=config.min_dist,
             bandwidth_pvalue=config.bandwidth_pvalue,
             do_alignment_proposals=config.do_alignment_proposals,
+            device=device,
         )
         # supervision surface: the supervisor reads these to detect a
         # crashed/stalled worker and to recover its in-flight requests
@@ -161,9 +170,7 @@ class Worker:
         axis rounds to the next power of two (and the mesh axis) so the
         number of distinct compiled batch shapes stays logarithmic."""
         self.faults.fire("compile")
-        mesh = self.config.mesh
-        n_axis = mesh.devices.size if mesh is not None else 1
-        gp = _bucket(pow2_bucket(n), max(n_axis, 1))
+        gp = mesh_round(n, self.config.mesh, pow2=True)
         return BucketPlan(key=key, band=self.config.band_bucket, gp=gp,
                           chunks=[list(range(n))])
 
@@ -198,9 +205,7 @@ class Worker:
             )
             for b, blk in enumerate(pk.blocks)
         ]
-        mesh = cfg.mesh
-        n_axis = mesh.devices.size if mesh is not None else 1
-        gp = _bucket(pow2_bucket(len(packs)), max(n_axis, 1))
+        gp = mesh_round(len(packs), cfg.mesh, pow2=True)
         # segment-grouped requests share the shape axes exactly; maxima
         # keep a mixed drain flush safe
         shape = tuple(
@@ -234,8 +239,7 @@ class Worker:
             key = live[0].key
             if seg:
                 plan, packs = self.seg_plan_for(live)
-                mesh = self.config.mesh
-                n_axis = mesh.devices.size if mesh is not None else 1
+                n_axis = mesh_axis_size(self.config.mesh)
                 if (n_axis > 1 and len(packs) < n_axis
                         and len(live) > len(packs)):
                     # mesh decline (same rule as plan_sweep): the mesh
@@ -462,7 +466,8 @@ class Worker:
                 break
             self.busy = True
             burst: List[Flush] = [item]
-            while True:
+            while (self.burst_limit is None
+                   or len(burst) < self.burst_limit):
                 try:
                     nxt = flush_q.get_nowait()
                 except Empty:

@@ -7,11 +7,29 @@ segfaults on this image. Suffixing the dir with a CPU-feature
 fingerprint keeps every machine in its own cache. Shared by the driver
 (engine.driver._enable_compilation_cache) and the test suite
 (tests/conftest.py) so the two schemes cannot drift.
+
+The fingerprint cannot catch every staleness mode: a runtime upgrade
+under an unchanged CPU (libtpu version bumps on TPU VMs are the
+recorded case) leaves entries whose AOT payload the new client refuses
+with ``FAILED_PRECONDITION: libtpu version mismatch``. The helpers
+below classify that error and drop the poisoned entries so callers can
+retry with a clean (or disabled) cache instead of failing the run.
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
+
+# substrings that identify a persistent-cache entry the CURRENT runtime
+# cannot load (vs a genuine compile error): the recorded failures are
+# "FAILED_PRECONDITION: libtpu version mismatch: terminal has ... client
+# AOT libtpu has ..." from device_put / executable deserialization, and
+# the CPU analogue from cpu_aot_loader
+_STALE_MARKERS = (
+    "libtpu version mismatch",
+    "cpu_aot_loader",
+)
 
 
 def machine_cache_dir(base: str) -> str:
@@ -26,3 +44,37 @@ def machine_cache_dir(base: str) -> str:
         flags = "unknown"
     fp = hashlib.md5(flags.encode()).hexdigest()[:10]
     return f"{base}_{fp}"
+
+
+def is_stale_cache_error(err) -> bool:
+    """Whether an exception (or captured output text) carries the
+    stale-AOT-cache signature: the named markers, or a
+    ``FAILED_PRECONDITION`` that mentions an AOT payload. Anything else
+    — including FAILED_PRECONDITION from a real shape/runtime problem —
+    is NOT classified stale; dropping the cache must never mask a
+    genuine failure."""
+    msg = str(err)
+    if any(m in msg for m in _STALE_MARKERS):
+        return True
+    return "FAILED_PRECONDITION" in msg and "AOT" in msg
+
+
+def clear_cache_dir(path) -> int:
+    """Drop every persistent-cache entry under ``path`` (files only; the
+    directory and any subdirectories stay, so a configured cache dir
+    remains valid). Returns the number of entries removed; missing or
+    unreadable paths are a 0-entry no-op — recovery must never raise."""
+    if not path:
+        return 0
+    removed = 0
+    try:
+        for root, _dirs, files in os.walk(path):
+            for name in files:
+                try:
+                    os.unlink(os.path.join(root, name))
+                    removed += 1
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return removed

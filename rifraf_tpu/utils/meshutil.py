@@ -1,0 +1,55 @@
+"""Shared mesh plumbing: the shard_map compat shim and mesh-axis rounding.
+
+Every layer that touches a device mesh needs the same two fragments:
+
+- ``shard_map`` moved from ``jax.experimental.shard_map`` into the top
+  namespace across JAX releases, renaming its varying-axes check from
+  ``check_rep`` to ``check_vma`` on the way. The shim here accepts the
+  NEW spelling and translates down, so call sites are written once
+  against the current API.
+- Batch axes that a mesh shards must round up to the mesh size so every
+  shard carries the same local extent. The serving worker additionally
+  rounds to powers of two first (logarithmic executable count); both
+  rules compose in :func:`mesh_round`.
+
+Hoisted out of ``parallel/sharding.py`` / ``serve/worker.py`` where the
+two fragments had been copied; import from here everywhere mesh code
+lives so the compat window and the rounding rule cannot drift.
+"""
+
+from __future__ import annotations
+
+from .shapes import bucket as _bucket
+from .shapes import pow2_bucket
+
+
+def shard_map_compat(*args, **kwargs):
+    """``jax.shard_map`` across the API migration: older releases keep
+    it in ``jax.experimental.shard_map`` and call the varying-axes check
+    ``check_rep`` instead of ``check_vma``. Write call sites against the
+    new spelling; this shim translates for the old one."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return shard_map(*args, **kwargs)
+
+
+def mesh_axis_size(mesh) -> int:
+    """Number of devices a mesh shards over (1 for ``None`` — unsharded
+    code paths pass their optional mesh straight through)."""
+    return int(mesh.devices.size) if mesh is not None else 1
+
+
+def mesh_round(n: int, mesh, pow2: bool = False) -> int:
+    """Round a batch-axis extent so a mesh shards it evenly.
+
+    ``pow2`` first rounds to the next power of two (the serving
+    worker's rule: the set of distinct compiled batch shapes stays
+    logarithmic), then to a multiple of the mesh axis — the order
+    matters, a power of two is not necessarily a mesh multiple."""
+    if pow2:
+        n = pow2_bucket(n)
+    return _bucket(n, mesh_axis_size(mesh))

@@ -29,6 +29,10 @@ from typing import Dict, List, Optional
 
 HBM_GBPS = float(os.environ.get("RIFRAF_TPU_HBM_GBPS", "819.0"))
 VPU_TOPS = float(os.environ.get("RIFRAF_TPU_VPU_TOPS", "3.8"))
+# v5e interchip interconnect: 1600 Gbps per chip (cloud.google.com/tpu/
+# docs/v5e) = 200 GB/s; override for other topologies with
+# RIFRAF_TPU_ICI_GBPS.
+ICI_GBPS = float(os.environ.get("RIFRAF_TPU_ICI_GBPS", "200.0"))
 
 _F32 = 4
 
@@ -170,6 +174,61 @@ def fused_mega_model(
             "tab_bytes": float(tab + tab2),
             "band_bytes": float(band_w + a_r + b_r),
             "moves_bytes": float(2 * moves if want_stats else 0.0)}
+
+
+def ici_collective_bytes(
+    T1p: int, n_devices: int, want_stats: bool = False,
+) -> float:
+    """Per-device ICI bytes of one read-axis-sharded fused step's
+    cross-chip epilogue (parallel.sharding.mesh_fused_step_pallas): a
+    ring all-reduce moves ``2 * (n - 1) / n`` of the reduced payload
+    through each device's links. The payload is the psum'd dense edit
+    tables — sub ``[T1p, 4]`` + ins ``[T1p, 4]`` + del ``[T1p]`` — plus
+    the total/convergence scalars, and with ``want_stats`` the pmax'd
+    edits-indicator union ``[T1p, 9]``. Per-read vectors (scores,
+    n_errors) stay shard-local and cost nothing."""
+    if n_devices <= 1:
+        return 0.0
+    payload = (9 * T1p + 2) * _F32
+    if want_stats:
+        payload += 9 * T1p * _F32
+    return payload * 2.0 * (n_devices - 1) / n_devices
+
+
+def mesh_fused_model(
+    T1p: int,
+    K: int,
+    Npad_local: int,
+    C: int,
+    n_devices: int,
+    want_stats: bool = False,
+    impl: str = "mega",
+) -> Dict[str, float]:
+    """One fused step sharded over ``n_devices`` chips: per-device HBM
+    bytes at the LOCAL lane count plus the ICI collective term, against
+    the single-device model at the full lane count — so read-axis
+    scaling efficiency is a modeled number. The returned
+    ``scaling_efficiency`` is the modeled speedup over one device
+    divided by ``n_devices`` (1.0 = perfectly linear; the ICI term and
+    any lane re-padding are what pull it below)."""
+    per_model = fused_mega_model if impl == "mega" else fused_model
+    per = per_model(T1p, K, Npad_local, C, want_stats=want_stats)
+    ici = ici_collective_bytes(T1p, n_devices, want_stats=want_stats)
+    t_dev = per["bytes"] / (HBM_GBPS * 1e9) + ici / (ICI_GBPS * 1e9)
+    one = per_model(T1p, K, Npad_local * n_devices, C,
+                    want_stats=want_stats)
+    t_one = one["bytes"] / (HBM_GBPS * 1e9)
+    speedup = t_one / t_dev if t_dev > 0 else float(n_devices)
+    return {
+        "bytes_per_device": float(per["bytes"]),
+        "ici_bytes_per_device": float(ici),
+        "ops_per_device": float(per["ops"]),
+        "single_device_bytes": float(one["bytes"]),
+        "t_model_s": float(t_dev),
+        "t_single_s": float(t_one),
+        "model_speedup": float(speedup),
+        "scaling_efficiency": float(speedup / max(n_devices, 1)),
+    }
 
 
 def utilization(nbytes: float, seconds: float) -> Dict[str, float]:

@@ -1,0 +1,227 @@
+"""Fast read-axis mesh identity tests: the sharded fused step and the
+per-device fleet vs their single-device equivalents.
+
+Everything here runs on the 8-virtual-device CPU mesh (tests/conftest.py
+forces XLA_FLAGS=--xla_force_host_platform_device_count=8) and stays in
+the fast tier — XLA engines only, tiny shapes. The sharded PALLAS launch
+path (mesh_fused_step_pallas through engine.realign) is covered by the
+slow interpret-mode test in tests/test_pallas_driver.py.
+
+Identity conventions (tests/test_parallel.py): per-lane outputs and
+max-unions compare EXACTLY (they never cross a shard boundary); reduced
+quantities — the psum'd totals and segment tables — compare at rtol
+1e-12, since an 8-way partial-sum tree may reassociate the f64
+additions.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from rifraf_tpu.engine.params import RifrafParams
+from rifraf_tpu.models.errormodel import ErrorModel
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax
+from rifraf_tpu.ops.fused import fused_step_segmented
+from rifraf_tpu.parallel.sharding import make_mesh, mesh_fused_step_segmented
+from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+from rifraf_tpu.sim.sample import sample_sequences
+from rifraf_tpu.utils.phred import phred_to_log_p
+
+SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+
+
+def _clusters(n_clusters, nseqs=4, length=30, seed=0):
+    rng = np.random.default_rng(seed)
+    params = RifrafParams()
+    out = []
+    for _ in range(n_clusters):
+        _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+            nseqs=nseqs, length=length, error_rate=0.03, rng=rng,
+            seq_errors=SEQ_ERRORS,
+        )
+        out.append([
+            make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                             params.bandwidth, params.scores)
+            for s, p in zip(seqs, phreds)
+        ])
+    return out
+
+
+def _packed_problem(n_seg, npad, seed=7):
+    """A segment-packed lane block: ``n_seg`` problems' reads in ``npad``
+    lanes, pad lanes duplicating slot 0's first read at weight 0 (the
+    executor's padding convention). Returns the fused_step_segmented
+    argument tuple (minus K/n_seg) plus K."""
+    clusters = _clusters(n_seg, nseqs=3, length=24 + 6 * n_seg, seed=seed)
+    tlens = [len(c[0]) for c in clusters]
+    Tmax = max(tlens) + 8
+    tmpl = np.zeros((n_seg, Tmax), np.int8)
+    for s, c in enumerate(clusters):
+        tmpl[s, : tlens[s]] = c[0].seq
+
+    reads, seg_ids, bws = [], [], []
+    for s, c in enumerate(clusters):
+        reads.extend(c)
+        seg_ids.extend([s] * len(c))
+        bws.extend(r.bandwidth for r in c)
+    n_live = len(reads)
+    pad = npad - n_live
+    assert pad >= 0
+    reads += [clusters[0][0]] * pad
+    seg_ids += [0] * pad
+    bws += [clusters[0][0].bandwidth] * pad
+    weights = np.asarray([1.0] * n_live + [0.0] * pad, np.float64)
+    L = max(len(r) for r in reads) + 4
+    b = batch_reads(reads, max_len=L, dtype=np.float64)
+
+    lane_tlens = np.asarray(tlens, np.int32)[np.asarray(seg_ids)]
+    geom = align_jax.BandGeometry.make(
+        jnp.asarray(b.lengths), jnp.asarray(lane_tlens),
+        jnp.asarray(bws, np.int32),
+    )
+    K = int(np.asarray(geom.nd).max() + np.asarray(geom.offset).max())
+    K = ((K + 7) // 8) * 8
+    args = (
+        jnp.asarray(tmpl), jnp.asarray(tlens, np.int32),
+        jnp.asarray(seg_ids, np.int32), jnp.asarray(b.seq),
+        jnp.asarray(b.match), jnp.asarray(b.mismatch),
+        jnp.asarray(b.ins), jnp.asarray(b.dels), jnp.asarray(b.lengths),
+        jnp.asarray(bws, np.int32), jnp.asarray(weights),
+    )
+    return args, K
+
+
+@pytest.mark.parametrize("want_stats", [False, True])
+@pytest.mark.parametrize("n_seg", [1, 3])
+def test_mesh_fused_step_segmented_matches_single(n_seg, want_stats):
+    """The shard_map-wrapped segmented fused step over the 8-device mesh
+    vs the single-device call: n_seg=1 is the whole-block layout (every
+    lane one segment), n_seg=3 the segment-packed one. Per-lane scores,
+    error counts, and the pmax'd edits union are exact; the psum'd
+    totals and segment tables agree at 1e-12."""
+    args, K = _packed_problem(n_seg, npad=16)
+    single = fused_step_segmented(*args, K, n_seg, want_stats=want_stats)
+    mesh = make_mesh(8)
+    sharded = mesh_fused_step_segmented(
+        mesh, *args, K=K, n_seg=n_seg, want_stats=want_stats)
+
+    np.testing.assert_array_equal(
+        np.asarray(sharded["scores"]), np.asarray(single["scores"]))
+    for name in ("total", "sub", "ins", "del"):
+        np.testing.assert_allclose(
+            np.asarray(sharded[name]), np.asarray(single[name]),
+            rtol=1e-12, atol=0, err_msg=name)
+    if want_stats:
+        np.testing.assert_array_equal(
+            np.asarray(sharded["n_errors"]), np.asarray(single["n_errors"]))
+        np.testing.assert_array_equal(
+            np.asarray(sharded["edits"]), np.asarray(single["edits"]))
+
+
+@pytest.mark.parametrize("dap", [False, True])
+def test_sweep_mesh_matches_unsharded(dap):
+    """End-to-end bit identity through the sweep executor: the same
+    clusters swept over the 8-device mesh and unsharded, under both
+    do_alignment_proposals settings (the edits-gated and all-edits
+    candidate paths)."""
+    clusters = _clusters(3, seed=3)
+    base = sweep_clusters_sharded(clusters, do_alignment_proposals=dap)
+    mesh = sweep_clusters_sharded(clusters, mesh=make_mesh(8),
+                                  do_alignment_proposals=dap)
+    for g, (a, b) in enumerate(zip(base, mesh)):
+        assert np.array_equal(a.consensus, b.consensus), g
+        assert np.isclose(a.score, b.score, rtol=1e-9), g
+        assert a.n_iters == b.n_iters, g
+
+
+@pytest.mark.parametrize("segment_pack", [False, True])
+def test_sweep_mesh_two_devices_scaling_sane(segment_pack):
+    """2-device scaling sanity for CI's multidevice job: a 2-device
+    submesh must produce the single-device answer on both the
+    segment-packed and whole-block layouts, and the mesh plan must keep
+    every cluster accounted for."""
+    clusters = _clusters(4, seed=9)
+    base = sweep_clusters_sharded(clusters, segment_pack=segment_pack)
+    mesh = sweep_clusters_sharded(clusters, mesh=make_mesh(2),
+                                  segment_pack=segment_pack)
+    assert len(mesh) == len(clusters)
+    for g, (a, b) in enumerate(zip(base, mesh)):
+        assert np.array_equal(a.consensus, b.consensus), g
+        assert np.isclose(a.score, b.score, rtol=1e-9), g
+
+
+def test_sweep_fleet_matches_single_worker():
+    """The per-device fleet (n_workers executors, chunks dealt
+    round-robin) returns bit-identical results to one worker: the
+    executors share one trace per bucket signature, only the placement
+    differs."""
+    clusters = _clusters(6, seed=5)
+    one = sweep_clusters_sharded(clusters, n_workers=1)
+    fleet = sweep_clusters_sharded(clusters, n_workers=3)
+    for g, (a, b) in enumerate(zip(one, fleet)):
+        assert np.array_equal(a.consensus, b.consensus), g
+        assert a.score == b.score, g
+        assert a.n_iters == b.n_iters, g
+        assert a.converged == b.converged, g
+
+
+def test_sweep_fleet_rejects_mesh():
+    with pytest.raises(ValueError, match="fleet"):
+        sweep_clusters_sharded(_clusters(1), mesh=make_mesh(2),
+                               n_workers=2)
+
+
+def test_serve_fleet_matches_single_worker():
+    """N serving workers on the shared flush queue == 1 worker, result
+    for result — the fleet only changes which device executes a flush,
+    never what it computes."""
+    from rifraf_tpu.serve import ServeConfig, submit_many
+
+    clusters = _clusters(5, seed=11)
+    single = submit_many(clusters,
+                         ServeConfig(max_wait_ms=2.0, n_workers=1))
+    fleet = submit_many(clusters,
+                        ServeConfig(max_wait_ms=2.0, n_workers=3))
+    assert all(r.ok for r in single)
+    assert all(r.ok for r in fleet)
+    for g, (a, b) in enumerate(zip(single, fleet)):
+        assert np.array_equal(a.consensus, b.consensus), g
+        assert a.score == b.score, g
+
+
+def test_serve_fleet_health_and_close():
+    from rifraf_tpu.serve import ConsensusServer, ServeConfig
+
+    server = ConsensusServer(ServeConfig(n_workers=2))
+    try:
+        h = server.health()
+        assert h["n_workers"] == 2
+        assert h["worker_alive"]
+        assert len(h["workers"]) == 2
+    finally:
+        server.close()
+    h = server.health()
+    assert h["closed"]
+    assert not h["worker_alive"]  # every worker consumed its STOP
+
+
+def test_serve_fleet_rejects_mesh():
+    from rifraf_tpu.serve import ConsensusServer, ServeConfig
+
+    with pytest.raises(ValueError, match="fleet"):
+        ConsensusServer(ServeConfig(n_workers=2, mesh=make_mesh(2)))
+
+
+def test_mesh_round_and_axis_size():
+    from rifraf_tpu.utils.meshutil import mesh_axis_size, mesh_round
+
+    assert mesh_axis_size(None) == 1
+    mesh = make_mesh(8)
+    assert mesh_axis_size(mesh) == 8
+    assert mesh_round(5, None) == 5
+    assert mesh_round(5, mesh) == 8
+    assert mesh_round(5, None, pow2=True) == 8
+    assert mesh_round(9, mesh, pow2=True) == 16
+    assert mesh_round(8, mesh, pow2=True) == 8

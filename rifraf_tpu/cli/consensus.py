@@ -88,6 +88,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(read-count grid of this size) so heterogeneous "
                         "inputs compile per bucket instead of padding to "
                         "the global maxima; 0 = legacy uniform scheduler")
+    p.add_argument("--journal", type=str, default="",
+                   help="with --sharded-sweep: write-ahead results "
+                        "journal (append-only JSONL, fsync'd per chunk) "
+                        "so a killed run can be resumed with --resume")
+    p.add_argument("--resume", action="store_true",
+                   help="with --journal: skip chunks the journal records "
+                        "as completed (outputs stay bit-identical to an "
+                        "uninterrupted run; the journal's config "
+                        "fingerprint must match)")
+    p.add_argument("--tolerant", action="store_true",
+                   help="stream FASTQ through the quarantine front door "
+                        "(io.stream): malformed records land in "
+                        "<stem>.quarantine.jsonl with a typed reason "
+                        "instead of aborting the run")
     p.add_argument("--verbose", "-v", type=int, default=0)
     p.add_argument("seq_errors", metavar="seq-errors",
                    help="comma-separated sequence error ratios - "
@@ -95,6 +109,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("input", help="a single file or a glob; filenames must be unique")
     p.add_argument("output", help="output fasta file")
     return p
+
+
+def read_fastq_tolerant(path: str, verbose: int = 0):
+    """FASTQ via the quarantine front door: malformed records go to
+    ``<stem>.quarantine.jsonl`` with a typed reason; only well-formed
+    reads come back. Same (seqs, phreds, names) contract as
+    ``read_fastq``."""
+    from ..io.stream import (QuarantineWriter, quarantine_path_for,
+                             stream_fastq)
+
+    seqs, phreds, names = [], [], []
+    with QuarantineWriter(quarantine_path_for(path)) as q:
+        for name, s, p in stream_fastq(path, q):
+            seqs.append(s)
+            phreds.append(p)
+            names.append(name)
+        if verbose >= 1 and q.n:
+            print(f"quarantined {q.n} record(s) from '{path}' "
+                  f"({q.counts})", file=sys.stderr)
+    return seqs, phreds, names
 
 
 def dofile(path: str, reffile: str, refid: str, args,
@@ -123,7 +157,10 @@ def dofile(path: str, reffile: str, refid: str, args,
 
     scores = parse_error_model(args.seq_errors)
     ref_scores = parse_error_model(args.ref_errors)
-    sequences, phreds, _ = read_fastq(path)
+    if getattr(args, "tolerant", False):
+        sequences, phreds, _ = read_fastq_tolerant(path, args.verbose)
+    else:
+        sequences, phreds, _ = read_fastq(path)
     if args.phred_cap > 0:
         phreds = [cap_phreds(p, args.phred_cap) for p in phreds]
     params = RifrafParams(
@@ -170,6 +207,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         basenames = [os.path.basename(f) for f in infiles]
         refids = [name_to_ref[n] for n in basenames]
 
+    if args.resume and not args.journal:
+        raise ValueError("--resume needs --journal PATH")
+    if args.journal and not args.sharded_sweep:
+        raise ValueError("--journal is a --sharded-sweep feature (the "
+                         "thread sweep has no chunk checkpoints)")
     if args.sharded_sweep:
         if args.reference:
             raise ValueError(
@@ -233,7 +275,10 @@ def _run_sharded_sweep(infiles: List[str], basenames: List[str], args):
     params = RifrafParams(scores=scores, max_iters=args.max_iters)
     clusters = []
     for path in infiles:
-        sequences, phreds, _ = read_fastq(path)
+        if args.tolerant:
+            sequences, phreds, _ = read_fastq_tolerant(path, args.verbose)
+        else:
+            sequences, phreds, _ = read_fastq(path)
         if args.phred_cap > 0:
             phreds = [cap_phreds(p, args.phred_cap) for p in phreds]
         clusters.append([
@@ -257,6 +302,8 @@ def _run_sharded_sweep(infiles: List[str], basenames: List[str], args):
         read_bucket=args.sweep_bucket or 8,
         do_alignment_proposals=params.do_alignment_proposals,
         return_stats=True,
+        journal_path=args.journal,
+        resume=args.resume,
     )
     if args.verbose >= 1:
         print(

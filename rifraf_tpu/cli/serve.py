@@ -21,11 +21,28 @@ Response line schema (``serve.Response.to_json_dict``)::
     {"id": "r2", "ok": false, "error": "deadline_exceeded",
      "message": "...", "latency_ms": 501.0}
 
-In ``--watch DIR`` mode, every ``*.jsonl`` file that appears in DIR is
-served and answered to ``<name>.out.jsonl`` alongside it; files must be
-complete when they appear (write elsewhere and rename in). ``--stats``
-prints the server's metrics snapshot (queue depth, batch occupancy,
-padding waste, latency percentiles, timers) as JSON to stderr on exit.
+In ``--watch DIR`` mode, every ``*.jsonl`` (requests) or
+``*.fastq``/``*.fq`` (raw reads, clustered by the ``<cluster>/<read>``
+name convention via the ``io.stream`` front door) file that appears in
+DIR is served and answered to ``<stem>.out.jsonl`` alongside it.
+Files may be written in place: dotfiles and ``*.tmp`` are ignored, a
+file is only read once its size is stable across two polls, and a
+trailing partial JSONL line is tolerated — its complete lines are
+served and the tail re-read on the next poll (a tail that never
+completes is quarantined as ``truncated``). Malformed records land in
+``<stem>.quarantine.jsonl`` with a typed reason instead of killing the
+process.
+
+Durability: watch mode write-ahead journals every completed request id
+to ``<stem>.journal.jsonl`` (fsync'd per response, ``io.journal``
+format); ``--resume`` replays the journals after a crash — ``kill -9``
+included — so completed requests are skipped and their files' outputs
+appended, not recomputed. ``--resume`` with ``--input FILE`` journals
+to the same sidecar next to FILE.
+
+``--stats`` prints the server's metrics snapshot (queue depth, batch
+occupancy, padding waste, latency percentiles, timers, quarantine
+counts) as JSON to stderr on exit.
 """
 
 from __future__ import annotations
@@ -64,14 +81,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", default="-",
                    help="JSONL response file, '-' for stdout (default)")
     p.add_argument("--watch", default="",
-                   help="serve *.jsonl files appearing in this directory "
-                        "instead of --input; responses go to "
-                        "<name>.out.jsonl next to each input")
+                   help="serve *.jsonl (requests) and *.fastq/*.fq (raw "
+                        "reads) files appearing in this directory instead "
+                        "of --input; responses go to <stem>.out.jsonl, "
+                        "malformed records to <stem>.quarantine.jsonl, "
+                        "completed request ids to <stem>.journal.jsonl")
     p.add_argument("--watch-once", action="store_true",
                    help="with --watch: serve the files present now, then "
                         "exit (instead of polling forever)")
     p.add_argument("--watch-poll-ms", type=float, default=200.0,
                    help="with --watch: directory poll interval")
+    p.add_argument("--resume", action="store_true",
+                   help="replay <stem>.journal.jsonl sidecars: skip "
+                        "request ids already completed by a previous "
+                        "(possibly killed) run and append to their "
+                        "outputs instead of recomputing")
     p.add_argument("--seq-errors", default="",
                    help="comma-separated sequence error ratios "
                         "(mismatch, insertion, deletion); default scores "
@@ -156,40 +180,68 @@ def parse_request(obj: dict, args, config: ServeConfig):
 
 class _Emitter:
     """Serialized completion-order JSONL writer (future callbacks fire
-    on server threads)."""
+    on server threads). With a journal attached, every OK response's id
+    is journaled AFTER its output line is durably written — so a resume
+    never skips a request whose output the crash swallowed."""
 
-    def __init__(self, fh):
+    def __init__(self, fh, journal=None, on_ok=None):
         self.fh = fh
+        self.journal = journal
+        self.on_ok = on_ok  # called with the id of each OK response
         self.lock = threading.Lock()
+        # future.result() returns once the result is SET, but the done
+        # callback that emits it runs afterwards on a server thread —
+        # so sinks may only be closed after drain() confirms every
+        # registered emission actually happened
+        self._cv = threading.Condition()
+        self._pending = 0
+
+    def expect(self) -> None:
+        """Register one future whose response this emitter will emit."""
+        with self._cv:
+            self._pending += 1
+
+    def drain(self, timeout_s: float) -> bool:
+        """Block until every expected response has been emitted (or the
+        timeout passes). Must be called before closing fh/journal."""
+        with self._cv:
+            return self._cv.wait_for(lambda: self._pending == 0,
+                                     timeout=timeout_s)
 
     def emit(self, obj: dict) -> None:
         with self.lock:
             self.fh.write(json.dumps(obj) + "\n")
             self.fh.flush()
+            if self.journal is not None:
+                try:
+                    os.fsync(self.fh.fileno())
+                except (OSError, ValueError, AttributeError):
+                    pass  # stdout/pipes: flush is the best we can do
+        if obj.get("ok"):
+            if self.journal is not None:
+                # only completions are journaled: failed requests are
+                # retried by a --resume run, not skipped
+                self.journal.append({"kind": "req", "id": obj.get("id")})
+            if self.on_ok is not None:
+                self.on_ok(obj.get("id"))
 
     def emit_response(self, fut) -> None:
-        self.emit(fut.result().to_json_dict())
+        try:
+            self.emit(fut.result().to_json_dict())
+        finally:
+            with self._cv:
+                self._pending -= 1
+                self._cv.notify_all()
 
 
-def serve_stream(lines, server: ConsensusServer, emitter: _Emitter,
-                 args, config: ServeConfig) -> int:
-    """Submit every JSONL line, riding backpressure; responses stream
-    out via future callbacks. Returns the number of requests admitted."""
+def serve_requests(requests, server: ConsensusServer, emitter: _Emitter,
+                   ) -> int:
+    """Submit parsed ``(rid, cluster, deadline_ms)`` requests, riding
+    backpressure; responses stream out via future callbacks. Returns
+    the number of requests admitted."""
     inflight: deque = deque()
     n = 0
-    for i, line in enumerate(lines):
-        line = line.strip()
-        if not line:
-            continue
-        rid = None
-        try:
-            obj = json.loads(line)
-            rid = obj.get("id")  # kept even when the rest is malformed
-            cluster, deadline_ms = parse_request(obj, args, config)
-        except (ValueError, KeyError, TypeError) as e:
-            emitter.emit({"id": rid or f"line{i}", "ok": False,
-                          "error": "bad_request", "message": str(e)})
-            continue
+    for rid, cluster, deadline_ms in requests:
         t0 = time.perf_counter()
         wait_s = server.config.result_timeout_s
         while True:
@@ -203,7 +255,7 @@ def serve_stream(lines, server: ConsensusServer, emitter: _Emitter,
                 # the queue) surfaces as a typed response, not a hang
                 if time.perf_counter() - t0 > wait_s:
                     fut = None
-                    emitter.emit({"id": rid or f"line{i}", "ok": False,
+                    emitter.emit({"id": rid, "ok": False,
                                   "error": e.code, "message": str(e)})
                     break
                 if inflight:
@@ -215,11 +267,12 @@ def serve_stream(lines, server: ConsensusServer, emitter: _Emitter,
                     time.sleep(1e-3)
             except ServeError as e:
                 fut = None
-                emitter.emit({"id": rid or f"line{i}", "ok": False,
+                emitter.emit({"id": rid, "ok": False,
                               "error": e.code, "message": str(e)})
                 break
         if fut is not None:
             inflight.append(fut)
+            emitter.expect()
             fut.add_done_callback(emitter.emit_response)
             n += 1
     while inflight:
@@ -232,6 +285,83 @@ def serve_stream(lines, server: ConsensusServer, emitter: _Emitter,
             # callbacks emit those responses, so no request goes
             # unanswered
             break
+    # callbacks fire on server threads after result() returns: wait for
+    # the emissions themselves before the caller closes any sink
+    emitter.drain(server.config.result_timeout_s)
+    return n
+
+
+def serve_stream(lines, server: ConsensusServer, emitter: _Emitter,
+                 args, config: ServeConfig, done_ids=frozenset()) -> int:
+    """Parse + submit every JSONL request line. Ids are stable
+    (``obj["id"]`` or the line index), so ``done_ids`` from a journal
+    skips previously completed requests idempotently."""
+
+    def gen():
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            rid = None
+            try:
+                obj = json.loads(line)
+                rid = obj.get("id")  # kept even when the rest is bad
+                if rid is None:
+                    rid = f"line{i}"
+                if rid in done_ids:
+                    continue
+                cluster, deadline_ms = parse_request(obj, args, config)
+            except (ValueError, KeyError, TypeError) as e:
+                emitter.emit({"id": rid or f"line{i}", "ok": False,
+                              "error": "bad_request", "message": str(e)})
+                continue
+            yield rid, cluster, deadline_ms
+
+    return serve_requests(gen(), server, emitter)
+
+
+def serve_fastq(path: str, server: ConsensusServer, emitter: _Emitter,
+                args, config: ServeConfig, done_ids=frozenset()) -> int:
+    """The streaming FASTQ front door: tolerant-parse ``path``
+    (malformed records to the ``<stem>.quarantine.jsonl`` sidecar),
+    group consecutive reads into clusters by the ``<cluster>/<read>``
+    name convention, and submit each cluster as one request (id = the
+    cluster name)."""
+    from ..engine.validate import InvalidInputError
+    from ..io.stream import (QuarantineWriter, group_clusters,
+                             quarantine_path_for, stream_fastq)
+
+    quarantine = QuarantineWriter(quarantine_path_for(path))
+
+    def gen():
+        records = stream_fastq(path, quarantine,
+                               faults=server.faults or None)
+        for cname, seqs, phreds, _names in group_clusters(records):
+            if cname in done_ids:
+                continue
+            try:
+                ph = [np.asarray(p, float) for p in phreds]
+                if args.phred_cap > 0:
+                    ph = [cap_phreds(p, args.phred_cap) for p in ph]
+                cluster = encode_cluster(seqs, phreds=ph, config=config)
+            except (InvalidInputError, ValueError) as e:
+                emitter.emit({"id": cname, "ok": False,
+                              "error": getattr(e, "code", "bad_request"),
+                              "message": str(e)})
+                continue
+            deadline_ms = args.deadline_ms if args.deadline_ms > 0 \
+                else None
+            yield cname, cluster, deadline_ms
+
+    try:
+        n = serve_requests(gen(), server, emitter)
+    finally:
+        quarantine.close()
+        if quarantine.n:
+            server.stats.count("quarantined", quarantine.n)
+    if args.verbose >= 1 and quarantine.n:
+        print(f"quarantined {quarantine.n} record(s) from '{path}' "
+              f"({quarantine.counts})", file=sys.stderr)
     return n
 
 
@@ -253,25 +383,177 @@ def _warmup(server: ConsensusServer, path: str, args,
         )
 
 
+# spool file types the watcher serves (everything else — sidecars,
+# dotfiles, in-progress *.tmp writes — is ignored)
+_WATCH_EXTS = (".jsonl", ".fastq", ".fq", ".fastq.gz", ".fq.gz")
+_SIDECAR_EXTS = (".out.jsonl", ".quarantine.jsonl", ".journal.jsonl")
+# polls a size-stable JSONL file may end without a newline before its
+# partial tail is declared truncated (quarantined) instead of re-read
+_TAIL_GIVEUP_POLLS = 5
+
+
+def watch_candidates(names) -> List[str]:
+    """Filter a directory listing to servable spool files: dotfiles,
+    ``*.tmp`` in-progress writes, and our own sidecar outputs are
+    ignored."""
+    out = []
+    for f in names:
+        if f.startswith("."):
+            continue
+        if ".tmp" in f:
+            continue
+        if f.endswith(_SIDECAR_EXTS):
+            continue
+        if f.endswith(_WATCH_EXTS):
+            out.append(f)
+    return sorted(out)
+
+
+def _load_file_journal(path: str, resume: bool):
+    """Prior completion state of one spool file: (done_ids, finished)."""
+    from ..io.journal import read_journal
+    from ..io.stream import journal_path_for
+
+    if not resume:
+        return set(), False
+    records, _torn = read_journal(journal_path_for(path))
+    done_ids = {r.get("id") for r in records if r.get("kind") == "req"}
+    finished = any(r.get("kind") == "done" for r in records)
+    return done_ids, finished
+
+
+class _WatchedFile:
+    """Per-file serving state across polls: size stability, ids served
+    so far (journal ∪ this process), and the partial-tail counter."""
+
+    def __init__(self, path: str, resume: bool):
+        self.path = path
+        self.last_size = -1
+        self.stable = 0  # consecutive polls at last_size
+        self.noeol_polls = 0  # stable polls ending without a newline
+        self.done_ids, self.finished = _load_file_journal(path, resume)
+        self.journal = None
+        self.out_fh = None
+
+    def poll_size(self) -> bool:
+        """Re-stat; returns whether the size is stable since last poll
+        (the appear-then-keep-writing race guard)."""
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            return False  # vanished mid-poll
+        stable = size == self.last_size
+        self.stable = self.stable + 1 if stable else 0
+        self.last_size = size
+        return stable
+
+    def open_sinks(self, resume: bool):
+        """Lazily open the output + journal sidecars (append when
+        resuming with prior completions, else truncate)."""
+        from ..io.journal import fingerprint, open_resumable
+        from ..io.stream import journal_path_for
+
+        if self.out_fh is not None:
+            return
+        stem = journal_path_for(self.path)[: -len(".journal.jsonl")]
+        header = {"fingerprint":
+                  fingerprint(os.path.basename(self.path))}
+        self.journal, _prior = open_resumable(
+            journal_path_for(self.path), header,
+            resume=resume and bool(self.done_ids))
+        mode = "a" if (resume and self.done_ids) else "w"
+        self.out_fh = open(stem + ".out.jsonl", mode)
+
+    def mark_done(self):
+        self.finished = True
+        if self.journal is not None:
+            self.journal.append({"kind": "done",
+                                 "n": len(self.done_ids)})
+        self.close_sinks()
+
+    def close_sinks(self):
+        if self.out_fh is not None:
+            self.out_fh.close()
+            self.out_fh = None
+        if self.journal is not None:
+            self.journal.close()
+            self.journal = None
+
+
+def _serve_watched_jsonl(wf: _WatchedFile, server, args, config,
+                         final: bool) -> bool:
+    """Serve the complete lines of a watched JSONL file. Returns True
+    when the file is fully served (trailing newline seen, or its
+    partial tail was given up on and quarantined)."""
+    with open(wf.path) as fh:
+        text = fh.read()
+    complete = text.endswith("\n") or text == ""
+    lines = text.splitlines()
+    tail = None
+    if not complete:
+        tail = lines.pop()  # partial trailing line: re-read next poll
+    # track ids as they complete so a re-poll of a growing file only
+    # submits NEW lines
+    served_before = set(wf.done_ids)
+    emitter = _Emitter(wf.out_fh, journal=wf.journal,
+                       on_ok=wf.done_ids.add)
+    serve_stream(lines, server, emitter, args, config,
+                 done_ids=served_before)
+    if complete:
+        return True
+    if final:
+        # the producer went quiet mid-line: quarantine the tail with a
+        # typed reason rather than waiting forever
+        from ..io.stream import QuarantineWriter, quarantine_path_for
+
+        with QuarantineWriter(quarantine_path_for(wf.path)) as q:
+            q.write(reason="truncated",
+                    message="file ends mid-line and stopped growing",
+                    source=wf.path, record=tail)
+        if args.verbose >= 1:
+            print(f"quarantined truncated tail of '{wf.path}'",
+                  file=sys.stderr)
+        return True
+    return False
+
+
 def _run_watch(server: ConsensusServer, args,
                config: ServeConfig) -> None:
-    done = set()
+    files: dict = {}
     while True:
-        fresh = sorted(
-            f for f in os.listdir(args.watch)
-            if f.endswith(".jsonl") and not f.endswith(".out.jsonl")
-            and f not in done
-        )
-        for name in fresh:
+        for name in watch_candidates(os.listdir(args.watch)):
             path = os.path.join(args.watch, name)
-            out_path = path[: -len(".jsonl")] + ".out.jsonl"
-            if args.verbose >= 1:
-                print(f"serving '{path}' -> '{out_path}'",
-                      file=sys.stderr)
-            with open(path) as infh, open(out_path, "w") as outfh:
-                serve_stream(infh, server, _Emitter(outfh), args, config)
-            done.add(name)
+            wf = files.get(name)
+            if wf is None:
+                wf = files[name] = _WatchedFile(path, args.resume)
+            if wf.finished:
+                continue
+            stable = wf.poll_size()
+            if not stable and not args.watch_once:
+                continue  # still growing (or brand new): next poll
+            is_fastq = not name.endswith(".jsonl")
+            if args.verbose >= 1 and wf.out_fh is None:
+                print(f"serving '{path}'", file=sys.stderr)
+            wf.open_sinks(args.resume)
+            if is_fastq:
+                # FASTQ spools are served whole once size-stable; a
+                # truly truncated record quarantines, never crashes
+                serve_fastq(path, server,
+                            _Emitter(wf.out_fh, journal=wf.journal),
+                            args, config, done_ids=wf.done_ids)
+                wf.mark_done()
+            else:
+                if not _serve_watched_jsonl(
+                        wf, server, args, config,
+                        final=(args.watch_once
+                               or wf.noeol_polls >= _TAIL_GIVEUP_POLLS)):
+                    wf.noeol_polls += 1
+                else:
+                    wf.mark_done()
         if args.watch_once:
+            for wf in files.values():
+                if not wf.finished:
+                    wf.mark_done()
             return
         time.sleep(args.watch_poll_ms / 1e3)
 
@@ -286,19 +568,49 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.watch:
             _run_watch(server, args, config)
         else:
-            infh = sys.stdin if args.input == "-" else open(args.input)
+            journal = None
+            done_ids: frozenset = frozenset()
+            out_mode = "w"
+            if args.resume:
+                if args.input == "-":
+                    raise SystemExit(
+                        "--resume needs --input FILE or --watch "
+                        "(stdin has no journal sidecar)")
+                from ..io.journal import fingerprint, open_resumable
+                from ..io.stream import journal_path_for
+
+                done_ids, _finished = _load_file_journal(
+                    args.input, resume=True)
+                journal, _prior = open_resumable(
+                    journal_path_for(args.input),
+                    {"fingerprint":
+                     fingerprint(os.path.basename(args.input))},
+                    resume=bool(done_ids))
+                if done_ids:
+                    out_mode = "a"
+            is_fastq = args.input != "-" and not args.input.endswith(
+                (".jsonl", ".json"))
+            infh = (None if is_fastq else
+                    sys.stdin if args.input == "-" else open(args.input))
             outfh = (sys.stdout if args.output == "-"
-                     else open(args.output, "w"))
+                     else open(args.output, out_mode))
+            emitter = _Emitter(outfh, journal=journal)
             try:
-                n = serve_stream(infh, server, _Emitter(outfh), args,
-                                 config)
+                if is_fastq:
+                    n = serve_fastq(args.input, server, emitter, args,
+                                    config, done_ids=done_ids)
+                else:
+                    n = serve_stream(infh, server, emitter, args,
+                                     config, done_ids=done_ids)
                 if args.verbose >= 1:
                     print(f"served {n} request(s)", file=sys.stderr)
             finally:
-                if infh is not sys.stdin:
+                if infh is not None and infh is not sys.stdin:
                     infh.close()
                 if outfh is not sys.stdout:
                     outfh.close()
+                if journal is not None:
+                    journal.close()
     except KeyboardInterrupt:
         pass
     finally:

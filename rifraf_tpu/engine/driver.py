@@ -795,10 +795,18 @@ def rifraf(
     `error_log_ps` (log10 error probabilities) or `phreds`.
     """
     from ..utils.constants import encode_seq
+    from .validate import validate_cluster
 
     _enable_compilation_cache()
     if params is None:
         params = RifrafParams()
+    if error_log_ps is None and phreds is None:
+        raise ValueError("provide error_log_ps or phreds")
+    # typed validation pass BEFORE any encoding or device dispatch:
+    # empty clusters, zero-length reads, seq/qual length mismatches,
+    # out-of-range phreds, and non-ACGT bytes raise InvalidInputError
+    # subclasses (ValueError-compatible) with record context
+    validate_cluster(dnaseqs, phreds, error_log_ps, source="rifraf")
     dnaseqs = [encode_seq(s) if isinstance(s, str) else np.asarray(s, np.int8)
                for s in dnaseqs]
     if isinstance(reference, str):
@@ -806,10 +814,6 @@ def rifraf(
     if isinstance(consensus, str):
         consensus = encode_seq(consensus)
     if error_log_ps is None:
-        if phreds is None:
-            raise ValueError("provide error_log_ps or phreds")
-        if any(np.min(p) < 0 for p in phreds):
-            raise ValueError("phred score cannot be negative")
         error_log_ps = [phred_to_log_p(p) for p in phreds]
 
     ref_len = 0 if reference is None else len(reference)

@@ -1,0 +1,210 @@
+"""Typed input validation at every API boundary.
+
+Malformed input used to surface as an opaque shape error deep inside
+jit (or as a plain ``ValueError`` with no machine-readable identity).
+This module is the single validation pass the public entry points run
+BEFORE any device dispatch: ``rifraf()``, ``sweep_clusters_sharded``,
+serving admission (``ConsensusServer.submit`` / ``encode_cluster``),
+and both CLI parsers all funnel raw clusters through
+``validate_cluster``.
+
+Every failure raises an ``InvalidInputError`` subclass. The hierarchy
+derives from ``ValueError`` (existing callers that catch ValueError
+keep working) and mirrors the serving errors' contract: a stable
+machine-readable ``code`` plus a ``context`` dict naming the offending
+record (read index, read name, source file/line when known) — the same
+``(code, context)`` pair the streaming front door (``io.stream``)
+writes to quarantine sidecars.
+
+Codes:
+
+- ``empty_cluster``    — a cluster with no reads;
+- ``zero_length_read`` — a read with no bases;
+- ``length_mismatch``  — seq and quality lengths differ;
+- ``phred_range``      — a phred outside [0, MAX_PHRED] or non-finite;
+- ``bad_alphabet``     — a base outside ACGT (N and other ambiguity
+  codes included: the engine's int8 encoding has no code for them);
+- ``malformed_record`` — a record that does not parse at all
+  (truncated FASTQ block, bad header, invalid JSON, missing fields);
+- ``truncated``        — an input cut off mid-record (EOF inside a
+  FASTQ block or a gzip stream that ends early).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+# FASTQ offset-33 printable range '!'..'~' — phreds beyond this cannot
+# round-trip through quality strings and signal corrupt input
+MAX_PHRED = 93
+
+_VALID_BASES = frozenset(b"ACGTacgt")
+
+
+class InvalidInputError(ValueError):
+    """Malformed input caught before device dispatch. Carries a stable
+    machine-readable ``code`` and a ``context`` dict naming the record
+    (the quarantine-sidecar / serving-response contract)."""
+
+    code = "invalid_input"
+
+    def __init__(self, message: str, **context):
+        super().__init__(message)
+        self.context = {k: v for k, v in context.items() if v is not None}
+
+
+class EmptyClusterInputError(InvalidInputError):
+    code = "empty_cluster"
+
+
+class EmptyReadError(InvalidInputError):
+    code = "zero_length_read"
+
+
+class LengthMismatchError(InvalidInputError):
+    code = "length_mismatch"
+
+
+class PhredRangeError(InvalidInputError):
+    code = "phred_range"
+
+
+class AlphabetError(InvalidInputError):
+    code = "bad_alphabet"
+
+
+class MalformedRecordError(InvalidInputError):
+    code = "malformed_record"
+
+
+class TruncatedInputError(InvalidInputError):
+    code = "truncated"
+
+
+def _where(name: Optional[str], index: Optional[int],
+           source: Optional[str]) -> str:
+    parts = []
+    if name:
+        parts.append(f"read {name!r}")
+    elif index is not None:
+        parts.append(f"read {index}")
+    if source:
+        parts.append(f"in {source}")
+    return (" (" + " ".join(parts) + ")") if parts else ""
+
+
+def validate_seq(seq, *, name: Optional[str] = None,
+                 index: Optional[int] = None,
+                 source: Optional[str] = None) -> None:
+    """One sequence — a DNA string or an int8 code array. Zero-length
+    reads and non-ACGT bytes raise typed errors with record context."""
+    ctx = dict(name=name, index=index, source=source)
+    if isinstance(seq, (str, bytes)):
+        if len(seq) == 0:
+            raise EmptyReadError(
+                f"zero-length read{_where(name, index, source)}", **ctx)
+        raw = seq.encode("ascii", "replace") if isinstance(seq, str) \
+            else seq
+        bad = [c for c in raw if c not in _VALID_BASES]
+        if bad:
+            ch = chr(bad[0])
+            raise AlphabetError(
+                f"invalid DNA character {ch!r}"
+                f"{_where(name, index, source)} (ACGT only; ambiguity "
+                "codes like 'N' have no engine encoding)",
+                base=ch, **ctx)
+        return
+    arr = np.asarray(seq)
+    if arr.size == 0:
+        raise EmptyReadError(
+            f"zero-length read{_where(name, index, source)}", **ctx)
+    if arr.min() < 0 or arr.max() > 3:
+        raise AlphabetError(
+            f"invalid base code {int(arr.min() if arr.min() < 0 else arr.max())}"
+            f"{_where(name, index, source)} (int8 codes must be in "
+            "[0, 3])", **ctx)
+
+
+def validate_phreds(phred, seq_len: Optional[int] = None, *,
+                    name: Optional[str] = None,
+                    index: Optional[int] = None,
+                    source: Optional[str] = None) -> None:
+    """One read's phred vector: numeric, finite, within
+    [0, MAX_PHRED], and matching the read length when given."""
+    ctx = dict(name=name, index=index, source=source)
+    try:
+        arr = np.asarray(phred, dtype=float)
+    except (TypeError, ValueError) as e:
+        raise PhredRangeError(
+            f"non-numeric phred values{_where(name, index, source)}: {e}",
+            **ctx) from None
+    if seq_len is not None and arr.size != seq_len:
+        raise LengthMismatchError(
+            f"quality length {arr.size} != sequence length {seq_len}"
+            f"{_where(name, index, source)}",
+            qual_len=int(arr.size), seq_len=int(seq_len), **ctx)
+    if arr.size == 0:
+        return
+    if not np.isfinite(arr).all():
+        raise PhredRangeError(
+            f"non-finite phred value{_where(name, index, source)}", **ctx)
+    lo, hi = float(arr.min()), float(arr.max())
+    if lo < 0:
+        raise PhredRangeError(
+            f"phred score cannot be negative (got {lo:g})"
+            f"{_where(name, index, source)}", value=lo, **ctx)
+    if hi > MAX_PHRED:
+        raise PhredRangeError(
+            f"phred score {hi:g} exceeds {MAX_PHRED}"
+            f"{_where(name, index, source)}", value=hi, **ctx)
+
+
+def validate_cluster(seqs: Sequence,
+                     phreds: Optional[Sequence] = None,
+                     error_log_ps: Optional[Sequence] = None,
+                     *, source: Optional[str] = None,
+                     names: Optional[Sequence[str]] = None) -> None:
+    """One cluster of reads + qualities — the unit of ``rifraf()``, one
+    serving request, and one sweep cluster. Raises an
+    ``InvalidInputError`` subclass on the first offending record."""
+    if seqs is None or len(seqs) == 0:
+        raise EmptyClusterInputError(
+            "cluster carries no reads" + (f" (in {source})" if source
+                                          else ""), source=source)
+    quals = phreds if phreds is not None else error_log_ps
+    if quals is not None and len(quals) != len(seqs):
+        raise LengthMismatchError(
+            f"{len(seqs)} sequences but {len(quals)} quality vectors"
+            + (f" (in {source})" if source else ""),
+            n_seqs=len(seqs), n_quals=len(quals), source=source)
+    for i, seq in enumerate(seqs):
+        name = names[i] if names is not None and i < len(names) else None
+        validate_seq(seq, name=name, index=i, source=source)
+        if phreds is not None:
+            validate_phreds(phreds[i], len(seqs[i]), name=name, index=i,
+                            source=source)
+        elif error_log_ps is not None:
+            lp = np.asarray(error_log_ps[i], dtype=float)
+            if lp.size != len(seq):
+                raise LengthMismatchError(
+                    f"error_log_p length {lp.size} != sequence length "
+                    f"{len(seq)}{_where(name, i, source)}",
+                    qual_len=int(lp.size), seq_len=len(seq),
+                    name=name, index=i, source=source)
+
+
+def validate_encoded_cluster(cluster, *,
+                             source: Optional[str] = None) -> None:
+    """A cluster of ready-made ``ReadScores`` at the serving admission
+    boundary: non-empty, and no zero-length members (a zero-length read
+    would reach the band geometry as a degenerate shape)."""
+    if not cluster:
+        raise EmptyClusterInputError(
+            "cluster carries no reads", source=source)
+    for i, r in enumerate(cluster):
+        if len(r) == 0:
+            raise EmptyReadError(
+                f"zero-length read{_where(None, i, source)}",
+                index=i, source=source)

@@ -7,3 +7,19 @@ from .fastx import (
     write_fastq,
     write_samples,
 )
+from .journal import (
+    Journal,
+    JournalError,
+    fingerprint,
+    open_resumable,
+    read_journal,
+)
+from .stream import (
+    QuarantineWriter,
+    cluster_key,
+    group_clusters,
+    journal_path_for,
+    quarantine_path_for,
+    stream_fastq,
+    stream_jsonl,
+)

@@ -1,0 +1,154 @@
+"""Write-ahead results journal: crash-safe progress for long pipelines.
+
+A genome-scale sweep or a spool-serving run is hours of device work; a
+preemption (``kill -9``, OOM, node loss) must not forfeit the chunks
+already computed. The journal is deliberately primitive — an
+append-only JSONL file, one record per line, ``fsync``'d on every
+append — because primitive is what survives: after ANY process death
+the file is a prefix of the intended history, possibly with one torn
+trailing line, and ``read_journal`` tolerates exactly that.
+
+Users: ``parallel.sweep_clusters_sharded(journal_path=..., resume=...)``
+journals one record per completed chunk (the per-cluster results, so a
+resumed sweep re-emits them bit-identically without recomputing), and
+the serve CLI journals completed request ids per spool file. Both pair
+the records with a ``header`` record carrying a config fingerprint, so
+a resume against different inputs/parameters is refused instead of
+silently mixing results.
+
+Journal grammar (one JSON object per line)::
+
+    {"kind": "header", "fingerprint": "...", ...}   # first line
+    {"kind": <record kind>, ...}                    # appended per unit
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import List, Optional, Tuple
+
+
+class JournalError(ValueError):
+    """A journal that cannot be resumed against (fingerprint mismatch,
+    header missing, unreadable)."""
+
+    code = "journal_mismatch"
+
+
+def fingerprint(*parts) -> str:
+    """Stable hex digest of a config/inputs description. Parts are
+    stringified with repr — pass primitives, tuples, and lists only."""
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(repr(p).encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:32]
+
+
+def read_journal(path: str) -> Tuple[List[dict], bool]:
+    """Load every complete record; a torn trailing line (the append the
+    crash interrupted) is dropped, not an error. Returns
+    ``(records, torn)``."""
+    if not os.path.exists(path):
+        return [], False
+    records: List[dict] = []
+    torn = False
+    with open(path, "rb") as fh:
+        data = fh.read()
+    lines = data.split(b"\n")
+    # a file that does not end with a newline has a torn tail; with one,
+    # the final split element is empty
+    tail = lines.pop() if lines else b""
+    if tail.strip():
+        torn = True
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            records.append(json.loads(ln))
+        except ValueError:
+            # a torn line mid-file means the bytes after it belong to a
+            # different write epoch — stop trusting anything past it
+            torn = True
+            break
+    return records, torn
+
+
+class Journal:
+    """Append-only, fsync-per-append JSONL writer. Thread-safe: the
+    sweep fleet appends from several worker threads."""
+
+    def __init__(self, path: str, header: Optional[dict] = None,
+                 resume: bool = False):
+        """``resume=True`` appends to an existing file (after the caller
+        validated its header); otherwise the file is truncated and
+        ``header`` (with ``kind="header"``) is written first."""
+        self.path = path
+        self._lock = threading.Lock()
+        mode = "ab" if (resume and os.path.exists(path)) else "wb"
+        self._fh = open(path, mode)
+        if mode == "ab" and self._fh.tell() > 0:
+            # the crash may have torn the final append; re-anchor at the
+            # last complete line so the next record starts clean
+            with open(path, "rb") as rf:
+                data = rf.read()
+            keep = data.rfind(b"\n") + 1
+            if keep < len(data):
+                self._fh.truncate(keep)
+                self._fh.seek(keep)
+        elif header is not None:
+            self.append(dict(header, kind="header"))
+
+    def append(self, record: dict) -> None:
+        line = (json.dumps(record) + "\n").encode()
+        with self._lock:
+            self._fh.write(line)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def open_resumable(path: str, header: dict, resume: bool
+                   ) -> Tuple[Journal, List[dict]]:
+    """The standard open protocol: validate + load prior records when
+    resuming, start fresh otherwise.
+
+    Returns ``(journal, prior_records)`` where ``prior_records`` is
+    empty unless ``resume`` found a journal whose header fingerprint
+    matches ``header["fingerprint"]``. A resume against a MISMATCHED
+    fingerprint raises ``JournalError`` — recomputing is recoverable,
+    silently mixing two configs' results is not."""
+    prior: List[dict] = []
+    if resume and os.path.exists(path):
+        records, _torn = read_journal(path)
+        if records:
+            head = records[0]
+            if (head.get("kind") != "header"
+                    or "fingerprint" not in head):
+                raise JournalError(
+                    f"{path}: journal has no header record; refusing "
+                    "to resume (delete it to start fresh)")
+            if head["fingerprint"] != header.get("fingerprint"):
+                raise JournalError(
+                    f"{path}: journal fingerprint "
+                    f"{head['fingerprint']!r} does not match this "
+                    f"run's {header.get('fingerprint')!r} — inputs or "
+                    "parameters changed; refusing to resume (delete "
+                    "the journal to start fresh)")
+            prior = records[1:]
+            return Journal(path, resume=True), prior
+    return Journal(path, header=header, resume=False), prior

@@ -1,0 +1,310 @@
+"""Streaming FASTQ/JSONL ingestion front door.
+
+``read_fastq`` is a batch loader with batch semantics: the first
+malformed record raises and the whole file is lost. A serving spool or
+a genome-scale run cannot afford that — one corrupt read in a
+million-record file must cost one quarantined record, not the job.
+
+This module is the tolerant counterpart. ``stream_fastq`` /
+``stream_jsonl`` are generators that yield every well-formed record
+and route every malformed one to a :class:`QuarantineWriter` sidecar
+(``<name>.quarantine.jsonl``) with a typed reason — the same stable
+codes as ``engine.validate`` (``malformed_record``, ``truncated``,
+``length_mismatch``, ``phred_range``, ``bad_alphabet``,
+``zero_length_read``) — so an operator can grep the sidecar, fix the
+producer, and re-submit just the quarantined records. The parsers
+never raise on input content; a process death can only come from the
+environment (or an injected ``crash`` fault).
+
+Truncation is a first-class state, not an error: a file being written
+concurrently (serve ``--watch``) legitimately ends mid-record, so
+``tolerate_tail=True`` swallows the partial tail silently for re-read
+on the next poll, while the default quarantines it with reason
+``truncated``. A gzip stream that ends before its end-of-stream marker
+is the same case.
+
+Chaos hook: each accepted record passes the ``ingest`` fault site
+(``serve.faults``), so the chaos suite can inject parse failures and
+truncation here like at any other pipeline stage.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import threading
+import zlib
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..engine.validate import (
+    InvalidInputError,
+    validate_phreds,
+    validate_seq,
+)
+from ..utils.constants import encode_seq
+from .fastx import PHRED_OFFSET
+
+_RECORD_SNIPPET = 200  # bytes of the offending record kept in quarantine
+
+# extensions the quarantine/journal path helpers strip so sidecars sit
+# next to the input as <stem>.quarantine.jsonl / <stem>.journal.jsonl
+_STRIP_EXTS = (".gz", ".fastq", ".fq", ".jsonl", ".json", ".fasta", ".fa")
+
+
+def _stem(path: str) -> str:
+    base = str(path)
+    for ext in _STRIP_EXTS:
+        if base.endswith(ext):
+            base = base[: -len(ext)]
+    return base
+
+
+def quarantine_path_for(input_path: str) -> str:
+    return _stem(input_path) + ".quarantine.jsonl"
+
+
+def journal_path_for(input_path: str) -> str:
+    return _stem(input_path) + ".journal.jsonl"
+
+
+class QuarantineWriter:
+    """Append-only JSONL sidecar of rejected records.
+
+    Lazily opened (a clean file produces no sidecar), fsync'd per entry
+    (the quarantine is the only copy of the bad record's identity), and
+    counting by reason for BENCH/stats reporting."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._fh = None
+        self._lock = threading.Lock()
+        self.counts: dict = {}
+
+    @property
+    def n(self) -> int:
+        return sum(self.counts.values())
+
+    def write(self, *, reason: str, message: str = "",
+              source: Optional[str] = None, index: Optional[int] = None,
+              record: Optional[str] = None, **extra) -> None:
+        entry = {"reason": reason, "message": message}
+        if source is not None:
+            entry["source"] = source
+        if index is not None:
+            entry["index"] = index
+        if record is not None:
+            entry["record"] = record[:_RECORD_SNIPPET]
+        entry.update({k: v for k, v in extra.items() if v is not None})
+        with self._lock:
+            self.counts[reason] = self.counts.get(reason, 0) + 1
+            if self.path is None:
+                return
+            if self._fh is None:
+                self._fh = open(self.path, "ab")
+            self._fh.write((json.dumps(entry) + "\n").encode())
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.close()
+
+    def __enter__(self) -> "QuarantineWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _fire_ingest(faults, quarantine: Optional[QuarantineWriter],
+                 source: str, index: int, record: Optional[str]) -> bool:
+    """Run the ingest fault site for one record. Returns True when an
+    injected (recoverable) fault should quarantine the record; an
+    injected crash (BaseException) propagates like a real process
+    death."""
+    if faults is None:
+        return False
+    try:
+        faults.fire("ingest")
+    except Exception as e:  # InjectedFaultError — crash variants pass through
+        if quarantine is not None:
+            quarantine.write(reason="injected_fault", message=str(e),
+                             source=source, index=index, record=record)
+        return True
+    return False
+
+
+class _Lines:
+    """readline with a line counter, so quarantine entries can say where."""
+
+    def __init__(self, fh):
+        self._fh = fh
+        self.lineno = 0
+
+    def readline(self) -> str:
+        ln = self._fh.readline()
+        if ln:
+            self.lineno += 1
+        return ln
+
+
+def _open_text(path: str):
+    if str(path).endswith(".gz"):
+        return gzip.open(path, "rt")
+    return open(path)
+
+
+def stream_fastq(path_or_fh, quarantine: Optional[QuarantineWriter] = None,
+                 *, faults=None, tolerate_tail: bool = False,
+                 source: Optional[str] = None,
+                 ) -> Iterator[Tuple[str, np.ndarray, np.ndarray]]:
+    """Yield ``(name, seq_codes, phreds)`` for every well-formed FASTQ
+    record; malformed records go to ``quarantine`` with a typed reason.
+
+    Never raises on input content — truncated blocks, CRLF endings,
+    non-ACGT bases, bad quality strings, and mid-stream gzip EOF all
+    become quarantine entries (or, for a truncated tail with
+    ``tolerate_tail=True``, a silent stop so a concurrently-written
+    file can be re-read on the next poll)."""
+    own = isinstance(path_or_fh, (str, os.PathLike))
+    src = source or (str(path_or_fh) if own else "<stream>")
+    fh = _open_text(path_or_fh) if own else path_or_fh
+    try:
+        yield from _stream_fastq_fh(_Lines(fh), quarantine, faults,
+                                    tolerate_tail, src)
+    except (EOFError, OSError, zlib.error) as e:
+        # gzip stream cut off before its end-of-stream marker (or the
+        # underlying file vanished mid-read): the records already
+        # yielded are good; the rest of the file is not an error state
+        if not tolerate_tail and quarantine is not None:
+            quarantine.write(reason="truncated",
+                             message=f"stream ended mid-record: {e}",
+                             source=src)
+    finally:
+        if own:
+            fh.close()
+
+
+def _stream_fastq_fh(lines: _Lines, quarantine, faults, tolerate_tail,
+                     source):
+    index = -1
+    while True:
+        header = lines.readline()
+        if not header:
+            return
+        h = header.rstrip("\r\n")
+        if not h:
+            continue
+        index += 1
+        if not h.startswith("@"):
+            if quarantine is not None:
+                quarantine.write(reason="malformed_record",
+                                 message=f"bad FASTQ header {h[:60]!r}",
+                                 source=source, index=index, record=h,
+                                 line=lines.lineno)
+            continue
+        block = [lines.readline() for _ in range(3)]
+        if not block[-1]:
+            # EOF inside the 4-line block: a truncated tail
+            if not tolerate_tail and quarantine is not None:
+                quarantine.write(reason="truncated",
+                                 message="file ends mid-record",
+                                 source=source, index=index, record=h,
+                                 line=lines.lineno)
+            return
+        seq, plus, qual = (ln.rstrip("\r\n") for ln in block)
+        name = h[1:].split()[0] if len(h) > 1 else f"seq_{index + 1}"
+        if not plus.startswith("+"):
+            if quarantine is not None:
+                quarantine.write(reason="malformed_record",
+                                 message="missing '+' separator line",
+                                 source=source, index=index, record=h,
+                                 name=name, line=lines.lineno)
+            continue
+        try:
+            validate_seq(seq, name=name, index=index, source=source)
+            if len(qual) != len(seq):
+                # empty quality strings land here too
+                from ..engine.validate import LengthMismatchError
+                raise LengthMismatchError(
+                    f"quality length {len(qual)} != sequence length "
+                    f"{len(seq)} (read {name!r} in {source})",
+                    qual_len=len(qual), seq_len=len(seq), name=name,
+                    index=index, source=source)
+            q = np.frombuffer(qual.encode("ascii", "replace"),
+                              dtype=np.uint8).astype(np.int16) - PHRED_OFFSET
+            validate_phreds(q, len(seq), name=name, index=index,
+                            source=source)
+        except InvalidInputError as e:
+            if quarantine is not None:
+                quarantine.write(reason=e.code, message=str(e),
+                                 source=source, index=index, record=h,
+                                 name=name, line=lines.lineno)
+            continue
+        if _fire_ingest(faults, quarantine, source, index, h):
+            continue
+        yield name, encode_seq(seq), q.astype(np.int8)
+
+
+def stream_jsonl(lines: Iterable[str],
+                 quarantine: Optional[QuarantineWriter] = None,
+                 *, faults=None, source: str = "<stream>",
+                 ) -> Iterator[dict]:
+    """Yield one parsed object per well-formed JSONL line; bad JSON and
+    non-object lines are quarantined with reason ``malformed_record``
+    instead of killing the stream."""
+    for index, raw in enumerate(lines):
+        ln = raw.strip()
+        if not ln:
+            continue
+        try:
+            obj = json.loads(ln)
+        except ValueError as e:
+            if quarantine is not None:
+                quarantine.write(reason="malformed_record",
+                                 message=f"invalid JSON: {e}",
+                                 source=source, index=index, record=ln)
+            continue
+        if not isinstance(obj, dict):
+            if quarantine is not None:
+                quarantine.write(reason="malformed_record",
+                                 message="JSONL line is not an object",
+                                 source=source, index=index, record=ln)
+            continue
+        if _fire_ingest(faults, quarantine, source, index, ln):
+            continue
+        yield obj
+
+
+def cluster_key(name: str) -> str:
+    """Reads named ``<cluster>/<read>`` (PacBio/ONT convention) group by
+    the prefix; undecorated names each form their own cluster."""
+    return name.rsplit("/", 1)[0] if "/" in name else name
+
+
+def group_clusters(records: Iterable[Tuple[str, np.ndarray, np.ndarray]],
+                   ) -> Iterator[Tuple[str, List[np.ndarray],
+                                       List[np.ndarray], List[str]]]:
+    """Group a *sorted-by-cluster* record stream into consecutive
+    clusters, yielding ``(cluster_name, seqs, phreds, names)`` as soon
+    as each cluster's last read passes — streaming, no full-file
+    buffering."""
+    key: Optional[str] = None
+    seqs: List[np.ndarray] = []
+    phreds: List[np.ndarray] = []
+    names: List[str] = []
+    for name, seq, q in records:
+        k = cluster_key(name)
+        if key is not None and k != key:
+            yield key, seqs, phreds, names
+            seqs, phreds, names = [], [], []
+        key = k
+        seqs.append(seq)
+        phreds.append(q)
+        names.append(name)
+    if key is not None:
+        yield key, seqs, phreds, names

@@ -1009,6 +1009,8 @@ def sweep_clusters_sharded(
     segment_pack: Optional[bool] = None,
     segment_align: int = 1,
     n_workers: int = 1,
+    journal_path: str = "",
+    resume: bool = False,
 ):
     """One consensus per cluster, all clusters in one device program.
 
@@ -1040,12 +1042,30 @@ def sweep_clusters_sharded(
     exclusive with ``mesh`` (a mesh shards ONE program over devices;
     the fleet runs independent programs per device).
 
+    ``journal_path`` enables the write-ahead results journal: every
+    completed chunk's per-cluster results are appended (one fsync'd
+    JSONL record each, io.journal format) as soon as its blocking fetch
+    lands, so a process death — ``kill -9`` included — forfeits at most
+    the chunks in flight. ``resume=True`` then replays the journal
+    (after checking its config fingerprint against this call's inputs
+    and parameters; a mismatch raises ``io.journal.JournalError``),
+    skips the journaled chunks, and returns results bit-identical to an
+    uninterrupted run. The checkpoint interval is ONE CHUNK: at most
+    one chunk per pipeline slot is recomputed.
+
     Returns the per-cluster results IN INPUT ORDER; with
     ``return_stats`` also a SweepStats (per-bucket occupancy, padding
     waste, and timing).
     """
     t_start = time.perf_counter()
     G = len(clusters)
+    # typed validation before any planning/packing: an empty cluster or
+    # zero-length read would otherwise die inside _cluster_infos or as
+    # an opaque shape error at pack time
+    from ..engine.validate import validate_encoded_cluster
+
+    for gi, c in enumerate(clusters):
+        validate_encoded_cluster(c, source=f"sweep cluster {gi}")
     infos = _cluster_infos(clusters)
     n_axis = mesh.devices.size if mesh is not None else 1
     plans = plan_sweep(
@@ -1090,36 +1110,86 @@ def sweep_clusters_sharded(
     seconds_lock = threading.Lock()
     out: List[Optional[SweepResult]] = [None] * G
 
+    # ---- write-ahead journal / resume (the checkpoint interval is one
+    # chunk: each completed chunk's results are fsync'd before the next
+    # collect, so a kill forfeits only the chunks in flight) ----
+    journal = None
+    done_tasks: set = set()
+    if journal_path:
+        from ..io.journal import fingerprint, open_resumable
+        from ..utils.constants import encode_seq
+
+        fp = fingerprint(
+            G, [tuple(i) for i in infos], max_iters, min_dist,
+            bandwidth_pvalue, len_bucket, cluster_chunk, scheduler,
+            read_bucket, band_bucket, do_alignment_proposals,
+            lane_target, segment_pack, segment_align,
+        )
+        journal, prior = open_resumable(
+            journal_path,
+            {"fingerprint": fp, "n_tasks": len(tasks), "n_clusters": G},
+            resume,
+        )
+        for rec in prior:
+            if rec.get("kind") != "chunk":
+                continue
+            ti = rec.get("task")
+            if not isinstance(ti, int) or not 0 <= ti < len(tasks):
+                continue
+            # replay: decode_seq/encode_seq and JSON float repr both
+            # roundtrip exactly, so replayed results are bit-identical
+            # to the run that journaled them
+            for ci, seq, score, n_iters, converged in rec["results"]:
+                out[ci] = SweepResult(
+                    consensus=encode_seq(seq), score=float(score),
+                    n_iters=int(n_iters), converged=bool(converged),
+                )
+            done_tasks.add(ti)
+    pending = [(ti, t) for ti, t in enumerate(tasks)
+               if ti not in done_tasks]
+
     def make_stages(executor):
         # one pack/run/collect triple per fleet worker; `out` writes are
         # index-addressed and chunk-disjoint so only the per-bucket
         # timing accumulator needs the lock
         def pack(task):
-            bi, plan, idxs = task
+            ti, (bi, plan, idxs) = task
             if isinstance(plan, SegmentBucketPlan):
-                return bi, True, executor.pack_seg(
+                return ti, bi, True, executor.pack_seg(
                     plan, idxs, clusters, infos)
-            return bi, False, executor.pack(plan, idxs, clusters, infos)
+            return ti, bi, False, executor.pack(
+                plan, idxs, clusters, infos)
 
         def run(arg):
-            bi, seg, packed = arg
+            ti, bi, seg, packed = arg
             t0 = time.perf_counter()
             handle = (executor.run_seg(packed) if seg
                       else executor.run(packed))
             with seconds_lock:
                 bucket_seconds[bi] += time.perf_counter() - t0
-            return bi, seg, handle
+            return ti, bi, seg, handle
 
         def collect(arg):
-            bi, seg, handle = arg
+            ti, bi, seg, handle = arg
             t0 = time.perf_counter()
             if seg:
-                for ci, r in executor.collect_seg(handle):
-                    out[ci] = r
+                pairs = executor.collect_seg(handle)
             else:
-                results = executor.collect(handle)
-                for ci, r in zip(handle[2], results):
-                    out[ci] = r
+                pairs = list(zip(handle[2], executor.collect(handle)))
+            for ci, r in pairs:
+                out[ci] = r
+            if journal is not None:
+                from ..utils.constants import decode_seq
+
+                journal.append({
+                    "kind": "chunk", "task": ti,
+                    "results": [
+                        [int(ci), decode_seq(r.consensus),
+                         float(r.score), int(r.n_iters),
+                         bool(r.converged)]
+                        for ci, r in pairs
+                    ],
+                })
             with seconds_lock:
                 bucket_seconds[bi] += time.perf_counter() - t0
 
@@ -1127,7 +1197,7 @@ def sweep_clusters_sharded(
 
     if len(executors) == 1:
         pack, run, collect = make_stages(executors[0])
-        pipeline_map(pack, run, collect, tasks)
+        pipeline_map(pack, run, collect, pending)
     else:
         # deal chunks round-robin across the fleet; each worker drives
         # its own double-buffered pipeline on its own thread. The
@@ -1136,7 +1206,8 @@ def sweep_clusters_sharded(
         # per-device executables come out of one (persistent,
         # fingerprinted) compilation cache — the grid warms once per
         # fleet, not once per worker.
-        shards = [tasks[w::len(executors)] for w in range(len(executors))]
+        shards = [pending[w::len(executors)]
+                  for w in range(len(executors))]
 
         def drive(w):
             pack, run, collect = make_stages(executors[w])
@@ -1153,6 +1224,8 @@ def sweep_clusters_sharded(
             drive(0)
         for th in threads:
             th.join()
+    if journal is not None:
+        journal.close()
 
     if not return_stats:
         return list(out)

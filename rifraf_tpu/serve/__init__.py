@@ -15,6 +15,7 @@ from .batcher import MicroBatcher
 from .errors import (
     DeadlineExceededError,
     EmptyClusterError,
+    InvalidRequestError,
     OversizeError,
     QueueFullError,
     ServeError,
@@ -43,6 +44,7 @@ __all__ = [
     "InjectedCrashError",
     "InjectedFaultError",
     "InternalError",
+    "InvalidRequestError",
     "MicroBatcher",
     "OversizeError",
     "QueueFullError",

@@ -46,6 +46,16 @@ class EmptyClusterError(ServeError):
     code = "empty_cluster"
 
 
+class InvalidRequestError(ServeError):
+    """The request failed the typed validation pass at admission
+    (``engine.validate``): zero-length reads, malformed cluster shape —
+    input that would otherwise surface as an opaque shape error deep
+    inside jit. The underlying ``InvalidInputError.code`` (e.g.
+    ``zero_length_read``) is preserved in the message."""
+
+    code = "invalid_input"
+
+
 class ServerClosedError(ServeError):
     """submit() after close(), or a request abandoned by close(): the
     drain deadline expired with the request still unresolved, so the

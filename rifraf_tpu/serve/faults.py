@@ -9,6 +9,9 @@ config can provoke every failure path reproducibly.
 
 Sites (fired by the server/worker at the matching point):
 
+- ``ingest``   — ``io.stream``'s record loop, once per accepted record
+  (an injected ``error`` quarantines the record; ``crash`` kills the
+  ingesting process like a real truncation-at-the-worst-moment);
 - ``admit``    — ``ConsensusServer.submit``, after validation, before
   the request enters the admission queue (raises to the CALLER);
 - ``pack``     — ``Worker._pack``, the host-side batch build;
@@ -56,7 +59,8 @@ from typing import Dict, List, Optional, Sequence
 
 ENV_VAR = "RIFRAF_TPU_FAULTS"
 
-SITES = ("admit", "pack", "compile", "dispatch", "fetch", "fallback")
+SITES = ("ingest", "admit", "pack", "compile", "dispatch", "fetch",
+         "fallback")
 KINDS = ("error", "crash", "delay")
 
 

@@ -129,6 +129,15 @@ class ServeConfig:
     # device — not both)
     n_workers: int = 1
 
+    # --- durability ---
+    # write-ahead completion hook: called as journal(response) from the
+    # worker AFTER the request's future resolves OK (never for
+    # rejections — those are safe to recompute). The serve CLI points
+    # this at a per-file io.journal.Journal so a killed spool run can
+    # --resume past completed request ids. Exceptions from the hook are
+    # swallowed + counted; durability must never take down serving
+    journal: Optional[object] = None
+
 
 def encode_cluster(
     seqs: Sequence,
@@ -142,10 +151,16 @@ def encode_cluster(
     from ..utils.constants import encode_seq
     from ..utils.phred import phred_to_log_p
 
+    from ..engine.validate import validate_cluster
+
     config = config or ServeConfig()
+    if error_log_ps is None and phreds is None:
+        raise ValueError("provide phreds or error_log_ps")
+    # typed validation BEFORE any encoding/device work: zero-length
+    # reads, seq/qual mismatches, out-of-range phreds, non-ACGT bytes
+    # raise InvalidInputError subclasses with record context here
+    validate_cluster(seqs, phreds, error_log_ps, source="encode_cluster")
     if error_log_ps is None:
-        if phreds is None:
-            raise ValueError("provide phreds or error_log_ps")
         error_log_ps = [phred_to_log_p(np.asarray(p, float)) for p in phreds]
     return [
         make_read_scores(

@@ -247,10 +247,14 @@ class ConsensusServer:
 
         Raises synchronously: ServerClosedError, ServerUnhealthyError
         (worker crash loop — the supervisor gave up), EmptyClusterError,
+        InvalidRequestError (typed validation — e.g. zero-length reads),
         OversizeError (hard shape limits), QueueFullError (bounded
         admission queue — the backpressure signal; back off and retry).
         """
+        from ..engine.validate import InvalidInputError, \
+            validate_encoded_cluster
         from ..parallel.sweep_sharded import bucket_key, cluster_info
+        from .errors import InvalidRequestError
 
         if self._closed:
             raise ServerClosedError("server is closed")
@@ -260,6 +264,13 @@ class ConsensusServer:
             )
         if not cluster:
             raise EmptyClusterError("request carries no reads")
+        try:
+            validate_encoded_cluster(cluster, source="submit")
+        except InvalidInputError as e:
+            # wrapped as a ServeError so serve_stream's typed-rejection
+            # handling catches it like every other admission refusal
+            self.stats.count("rejected_invalid")
+            raise InvalidRequestError(f"[{e.code}] {e}") from e
         cfg = self.config
         info = cluster_info(cluster)
         if info.n_reads > cfg.max_reads or info.max_len > cfg.max_len:

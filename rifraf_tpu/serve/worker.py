@@ -325,13 +325,22 @@ class Worker:
     def _respond_ok(self, req: Request, res: SweepResult,
                     path: str) -> None:
         lat = time.perf_counter() - req.t_submit
-        if resolve_future(req, Response(
+        response = Response(
             id=req.id, ok=True, consensus=res.consensus, score=res.score,
             n_iters=res.n_iters, converged=res.converged, latency_s=lat,
             path=path,
-        ), self.stats):
+        )
+        if resolve_future(req, response, self.stats):
             self.stats.observe_latency(lat)
             self.stats.count("completed")
+            if self.config.journal is not None:
+                # write-ahead completion record; a broken journal must
+                # never take down serving, so failures are counted, not
+                # raised
+                try:
+                    self.config.journal(response)
+                except Exception:
+                    self.stats.count("journal_errors")
 
     def _run_fallback(self, req: Request) -> SweepResult:
         """PR 1 per-cluster device loop, in the batched path's exact

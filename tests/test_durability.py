@@ -1,0 +1,601 @@
+"""Durable pipelines: typed validation at every API boundary, the
+crash-tolerant streaming ingestion front door (malformed records ->
+quarantine sidecar, never a process death), the write-ahead results
+journal, and sweep/serve checkpoint-resume after a kill.
+
+Fast tests cover the validation hierarchy, the FASTQ/JSONL fuzz corpus
+(zero crashes, 100% quarantined-with-reason), journal torn-tail
+recovery, and the watch-scanner rules. Slow tests run the resume grid:
+a sweep crashed (exception and SIGKILL) after chunk k resumes
+bit-identically recomputing at most one checkpoint interval, and the
+serve CLI spool journal round-trips."""
+
+import gzip
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from rifraf_tpu.engine.validate import (
+    MAX_PHRED,
+    AlphabetError,
+    EmptyClusterInputError,
+    EmptyReadError,
+    InvalidInputError,
+    LengthMismatchError,
+    PhredRangeError,
+    validate_cluster,
+    validate_encoded_cluster,
+    validate_phreds,
+    validate_seq,
+)
+from rifraf_tpu.io.journal import (
+    Journal,
+    JournalError,
+    fingerprint,
+    open_resumable,
+    read_journal,
+)
+from rifraf_tpu.io.stream import (
+    QuarantineWriter,
+    cluster_key,
+    group_clusters,
+    journal_path_for,
+    quarantine_path_for,
+    stream_fastq,
+    stream_jsonl,
+)
+
+# ------------------------------------------------------------ validation
+
+
+def test_validation_codes_and_valueerror_compat():
+    cases = [
+        (lambda: validate_seq(""), EmptyReadError, "zero_length_read"),
+        (lambda: validate_seq("ACGN"), AlphabetError, "bad_alphabet"),
+        (lambda: validate_seq(np.zeros(0, np.int8)), EmptyReadError,
+         "zero_length_read"),
+        (lambda: validate_seq(np.array([0, 4], np.int8)), AlphabetError,
+         "bad_alphabet"),
+        (lambda: validate_phreds([1, -2], 2), PhredRangeError,
+         "phred_range"),
+        (lambda: validate_phreds([1, MAX_PHRED + 1], 2), PhredRangeError,
+         "phred_range"),
+        (lambda: validate_phreds([np.nan], 1), PhredRangeError,
+         "phred_range"),
+        (lambda: validate_phreds([1], 2), LengthMismatchError,
+         "length_mismatch"),
+        (lambda: validate_cluster([]), EmptyClusterInputError,
+         "empty_cluster"),
+        (lambda: validate_cluster(["ACGT"], phreds=[[1], [2]]),
+         LengthMismatchError, "length_mismatch"),
+    ]
+    for fn, exc, code in cases:
+        with pytest.raises(exc) as ei:
+            fn()
+        # the whole hierarchy stays ValueError-compatible (existing
+        # callers catching ValueError keep working) and every error
+        # carries its stable machine-readable code
+        assert isinstance(ei.value, InvalidInputError)
+        assert isinstance(ei.value, ValueError)
+        assert ei.value.code == code
+
+
+def test_validation_record_context():
+    with pytest.raises(AlphabetError) as ei:
+        validate_cluster(["ACGT", "ACXT"], phreds=[[9] * 4, [9] * 4],
+                         source="reads.fastq", names=["r1", "r2"])
+    assert ei.value.context["index"] == 1
+    assert ei.value.context["name"] == "r2"
+    assert ei.value.context["source"] == "reads.fastq"
+    assert "r2" in str(ei.value) and "reads.fastq" in str(ei.value)
+
+
+def test_max_phred_boundary_accepted():
+    validate_phreds([0, MAX_PHRED], 2)  # inclusive range, no raise
+
+
+def test_rifraf_raises_typed_errors_before_dispatch():
+    from rifraf_tpu.engine.driver import rifraf
+
+    with pytest.raises(EmptyClusterInputError):
+        rifraf([], phreds=[])
+    with pytest.raises(PhredRangeError, match="negative"):
+        rifraf(["ACGT"], phreds=[np.array([9, 9, 9, -1])])
+    with pytest.raises(AlphabetError):
+        rifraf(["ACGN"], phreds=[np.full(4, 9)])
+    with pytest.raises(LengthMismatchError):
+        rifraf(["ACGT"], phreds=[np.full(3, 9)])
+    with pytest.raises(EmptyReadError):
+        rifraf(["ACGT", ""], phreds=[np.full(4, 9), np.zeros(0)])
+    with pytest.raises(ValueError):  # legacy contract intact
+        rifraf(["ACGT"])
+
+
+def test_encode_cluster_raises_typed_errors():
+    from rifraf_tpu.serve import encode_cluster
+
+    with pytest.raises(EmptyClusterInputError):
+        encode_cluster([], phreds=[])
+    with pytest.raises(AlphabetError):
+        encode_cluster(["ACGU"], phreds=[np.full(4, 9)])
+    with pytest.raises(PhredRangeError):
+        encode_cluster(["ACGT"], phreds=[np.array([9, 9, 9, 99.0])])
+
+
+def test_sweep_rejects_invalid_cluster_before_planning():
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+
+    with pytest.raises(EmptyClusterInputError):
+        sweep_clusters_sharded([[]])
+
+
+def test_serve_admission_raises_invalid_request():
+    from rifraf_tpu import serve
+
+    class _FakeRead:
+        def __len__(self):
+            return 0
+
+    cfg = serve.ServeConfig(batch_max_reads=1, supervise=False)
+    with serve.ConsensusServer(cfg) as srv:
+        with pytest.raises(serve.InvalidRequestError) as ei:
+            srv.submit([_FakeRead()])
+        assert ei.value.code == "invalid_input"
+        assert isinstance(ei.value, serve.ServeError)
+        assert "zero_length_read" in str(ei.value)
+
+
+# ------------------------------------------------- streaming front door
+
+# self-contained corpus cases: (fastq text, n yielded, quarantine
+# reasons). Each case re-syncs the 4-line framing at its end, so any
+# sequence of cases composes into one corpus with summed expectations.
+_CASES = {
+    "good": ("@c1/r1\nACGT\n+\nIIII\n", 1, []),
+    "crlf": ("@c1/r2\r\nACGT\r\n+\r\nIIII\r\n", 1, []),
+    "bad_base": ("@b\nACGN\n+\nIIII\n", 0, ["bad_alphabet"]),
+    "empty_qual": ("@b\nACGT\n+\n\n", 0, ["length_mismatch"]),
+    "neg_phred": ("@b\nACGT\n+\nII I\n", 0, ["phred_range"]),
+    "no_plus": ("@b\nACGT\nACGT\nIIII\n", 0, ["malformed_record"]),
+    "bad_header": ("garbage line\n", 0, ["malformed_record"]),
+    "empty_read": ("@b\n\n+\n\n", 0, ["zero_length_read"]),
+    "blank": ("\n", 0, []),
+}
+
+
+def test_fastq_fuzz_corpus_zero_crashes_all_quarantined_with_reason():
+    rng = np.random.default_rng(0)
+    names = list(_CASES)
+    picks = [names[i] for i in rng.integers(0, len(names), 200)]
+    corpus = "".join(_CASES[p][0] for p in picks)
+    want_yield = sum(_CASES[p][1] for p in picks)
+    want_reasons: dict = {}
+    for p in picks:
+        for r in _CASES[p][2]:
+            want_reasons[r] = want_reasons.get(r, 0) + 1
+
+    q = QuarantineWriter(None)
+    got = list(stream_fastq(io.StringIO(corpus), q, source="fuzz"))
+    assert len(got) == want_yield
+    assert q.counts == want_reasons
+    # every record parses into the engine alphabet
+    for name, seq, phreds in got:
+        assert seq.dtype == np.int8 and seq.min() >= 0 and seq.max() <= 3
+        assert len(phreds) == len(seq) and phreds.min() >= 0
+
+
+def test_fastq_truncated_tail_quarantined_or_tolerated(tmp_path):
+    text = "@a\nACGT\n+\nIIII\n@tail\nAC\n"
+    q = QuarantineWriter(str(tmp_path / "q.jsonl"))
+    got = list(stream_fastq(io.StringIO(text), q, source="t.fastq"))
+    assert [r[0] for r in got] == ["a"]
+    assert q.counts == {"truncated": 1}
+    q.close()
+    entries = [json.loads(l) for l in open(q.path)]
+    assert entries[0]["reason"] == "truncated"
+    assert entries[0]["source"] == "t.fastq"
+    # watch mode: the tail is a file still being written — silence
+    q2 = QuarantineWriter(None)
+    assert [r[0] for r in
+            stream_fastq(io.StringIO(text), q2, tolerate_tail=True)
+            ] == ["a"]
+    assert q2.counts == {}
+
+
+def test_fastq_gzip_midstream_eof_quarantined_not_fatal(tmp_path):
+    payload = "".join(f"@r{i}\nACGTACGT\n+\nIIIIIIII\n"
+                      for i in range(50)).encode()
+    blob = gzip.compress(payload)
+    cut = tmp_path / "cut.fastq.gz"
+    cut.write_bytes(blob[: len(blob) // 2])
+    q = QuarantineWriter(None)
+    got = list(stream_fastq(str(cut), q))
+    # some prefix decodes; the EOF mid-stream is a typed quarantine
+    # entry, not an exception
+    assert len(got) < 50
+    assert q.counts.get("truncated") == 1
+
+
+def test_jsonl_fuzz_bad_lines_quarantined():
+    lines = ['{"id": "a"}', "not json", "[1, 2]", "", '{"id": "b"}',
+             '{"id": "c"', "42"]
+    q = QuarantineWriter(None)
+    got = list(stream_jsonl(lines, q, source="reqs.jsonl"))
+    assert [o["id"] for o in got] == ["a", "b"]
+    assert q.counts == {"malformed_record": 4}
+
+
+def test_ingest_fault_site_error_quarantines_crash_propagates():
+    from rifraf_tpu.serve.faults import FaultPlan, InjectedCrashError
+
+    text = "@a\nACG\n+\nIII\n@b\nACG\n+\nIII\n"
+    q = QuarantineWriter(None)
+    got = list(stream_fastq(io.StringIO(text), q,
+                            faults=FaultPlan.parse("ingest:error:n=1")))
+    assert [r[0] for r in got] == ["b"]
+    assert q.counts == {"injected_fault": 1}
+    # kind="crash" must NOT be contained — it is the simulated process
+    # death the journal/resume machinery exists for
+    with pytest.raises(InjectedCrashError):
+        list(stream_fastq(io.StringIO(text), QuarantineWriter(None),
+                          faults=FaultPlan.parse("ingest:crash")))
+
+
+def test_cluster_grouping_by_name_prefix():
+    assert cluster_key("c1/r5") == "c1"
+    assert cluster_key("solo") == "solo"
+    recs = [("c1/r1", np.zeros(3, np.int8), np.zeros(3, np.int8)),
+            ("c1/r2", np.zeros(3, np.int8), np.zeros(3, np.int8)),
+            ("c2/r1", np.zeros(3, np.int8), np.zeros(3, np.int8))]
+    groups = list(group_clusters(iter(recs)))
+    assert [(g[0], len(g[1])) for g in groups] == [("c1", 2), ("c2", 1)]
+
+
+def test_sidecar_paths():
+    assert quarantine_path_for("/d/in.fastq.gz") == \
+        "/d/in.quarantine.jsonl"
+    assert quarantine_path_for("/d/in.jsonl") == "/d/in.quarantine.jsonl"
+    assert journal_path_for("/d/in.fq") == "/d/in.journal.jsonl"
+
+
+# --------------------------------------------------------------- journal
+
+
+def test_journal_append_is_fsyncd_and_torn_tail_recovered(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    j, prior = open_resumable(p, {"fingerprint": "f1"}, resume=False)
+    assert prior == []
+    j.append({"kind": "chunk", "task": 0})
+    j.append({"kind": "chunk", "task": 1})
+    j.close()
+    # a kill mid-append leaves a torn trailing line
+    with open(p, "ab") as fh:
+        fh.write(b'{"kind": "chu')
+    records, torn = read_journal(p)
+    assert torn and [r["kind"] for r in records] == \
+        ["header", "chunk", "chunk"]
+    # resuming re-anchors at the last complete record and appends clean
+    j2, prior = open_resumable(p, {"fingerprint": "f1"}, resume=True)
+    assert [r["task"] for r in prior] == [0, 1]
+    j2.append({"kind": "chunk", "task": 2})
+    j2.close()
+    records, torn = read_journal(p)
+    assert not torn and [r.get("task") for r in records[1:]] == [0, 1, 2]
+
+
+def test_journal_fingerprint_mismatch_refused(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    j, _ = open_resumable(p, {"fingerprint": "f1"}, resume=False)
+    j.close()
+    with pytest.raises(JournalError, match="fingerprint"):
+        open_resumable(p, {"fingerprint": "OTHER"}, resume=True)
+    # without resume the journal is simply restarted
+    j2, prior = open_resumable(p, {"fingerprint": "OTHER"}, resume=False)
+    j2.close()
+    assert prior == [] and read_journal(p)[0][0]["fingerprint"] == "OTHER"
+
+
+def test_fingerprint_stable_and_discriminating():
+    a = fingerprint(1, [("x", 2)], "bucketed")
+    assert a == fingerprint(1, [("x", 2)], "bucketed")
+    assert a != fingerprint(1, [("x", 3)], "bucketed")
+
+
+# ----------------------------------------------------- watch-spool rules
+
+
+def test_watch_candidates_filtering():
+    from rifraf_tpu.cli.serve import watch_candidates
+
+    names = ["a.jsonl", "b.fastq", "c.fq", "d.fastq.gz",
+             ".hidden.jsonl", "e.jsonl.tmp", "f.tmp.jsonl",
+             "a.out.jsonl", "a.quarantine.jsonl", "a.journal.jsonl",
+             "notes.txt"]
+    assert watch_candidates(names) == \
+        ["a.jsonl", "b.fastq", "c.fq", "d.fastq.gz"]
+
+
+def test_load_file_journal(tmp_path):
+    from rifraf_tpu.cli.serve import _load_file_journal
+
+    path = str(tmp_path / "in.jsonl")
+    jp = journal_path_for(path)
+    with Journal(jp, header={"fingerprint": fingerprint("in.jsonl")}) as j:
+        j.append({"kind": "req", "id": "q0"})
+        j.append({"kind": "req", "id": "q1"})
+    done, finished = _load_file_journal(path, resume=True)
+    assert done == {"q0", "q1"} and not finished
+    with Journal(jp, resume=True) as j:
+        j.append({"kind": "done", "n": 2})
+    done, finished = _load_file_journal(path, resume=True)
+    assert finished
+    # resume off: prior journals are ignored
+    assert _load_file_journal(path, resume=False) == (set(), False)
+
+
+# ------------------------------------------------- resume grid (slow)
+
+
+def _tiny_clusters(n=5, nseqs=4, length=40, seed=0):
+    from rifraf_tpu.engine.params import RifrafParams
+    from rifraf_tpu.models.errormodel import ErrorModel
+    from rifraf_tpu.models.sequences import make_read_scores
+    from rifraf_tpu.sim.sample import sample_sequences
+    from rifraf_tpu.utils.phred import phred_to_log_p
+
+    rng = np.random.default_rng(seed)
+    params = RifrafParams()
+    seq_errors = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+    clusters = []
+    for _ in range(n):
+        _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+            nseqs=nseqs, length=length, error_rate=0.03, rng=rng,
+            seq_errors=seq_errors,
+        )
+        clusters.append([
+            make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                             params.bandwidth, params.scores)
+            for s, p in zip(seqs, phreds)
+        ])
+    return clusters
+
+
+# small chunks + no lane coalescing => several checkpointable chunks
+_SWEEP_KW = dict(cluster_chunk=2, lane_target=0, segment_pack=False)
+
+
+def _assert_results_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert np.array_equal(ra.consensus, rb.consensus)
+        assert float(ra.score) == float(rb.score)
+        assert int(ra.n_iters) == int(rb.n_iters)
+        assert bool(ra.converged) == bool(rb.converged)
+
+
+@pytest.mark.slow
+def test_sweep_resume_after_crash_recomputes_one_interval(
+        monkeypatch, tmp_path):
+    """Crash the sweep after chunk 1 of 3; --resume must produce
+    bit-identical results while recomputing only the un-journaled
+    chunks (<= one checkpoint interval beyond the completed set)."""
+    from rifraf_tpu.parallel.sweep_sharded import (
+        ChunkExecutor,
+        sweep_clusters_sharded,
+    )
+
+    clusters = _tiny_clusters()
+    reference = sweep_clusters_sharded(clusters, **_SWEEP_KW)
+
+    jp = str(tmp_path / "sweep.journal.jsonl")
+    orig_collect = ChunkExecutor.collect
+    state = {"n": 0}
+
+    def crashing(self, handle):
+        if state["n"] >= 1:
+            raise RuntimeError("injected mid-sweep death")
+        state["n"] += 1
+        return orig_collect(self, handle)
+
+    monkeypatch.setattr(ChunkExecutor, "collect", crashing)
+    with pytest.raises(RuntimeError, match="mid-sweep death"):
+        sweep_clusters_sharded(clusters, journal_path=jp, **_SWEEP_KW)
+    records, _ = read_journal(jp)
+    n_journaled = sum(r.get("kind") == "chunk" for r in records)
+    assert n_journaled == 1  # the fsync'd checkpoint survived the crash
+
+    counted = {"n": 0}
+
+    def counting(self, handle):
+        counted["n"] += 1
+        return orig_collect(self, handle)
+
+    monkeypatch.setattr(ChunkExecutor, "collect", counting)
+    resumed = sweep_clusters_sharded(clusters, journal_path=jp,
+                                     resume=True, **_SWEEP_KW)
+    _assert_results_equal(reference, resumed)
+
+    records, _ = read_journal(jp)
+    chunk_tasks = [r["task"] for r in records if r.get("kind") == "chunk"]
+    assert len(chunk_tasks) == len(set(chunk_tasks))  # no recompute
+    assert counted["n"] == len(chunk_tasks) - n_journaled
+    # mismatched parameters refuse to resume rather than mixing results
+    with pytest.raises(JournalError, match="fingerprint"):
+        sweep_clusters_sharded(clusters, journal_path=jp, resume=True,
+                               cluster_chunk=3, lane_target=0,
+                               segment_pack=False)
+
+
+_KILL_CHILD = r"""
+import os, signal, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {testdir!r})
+from test_durability import _tiny_clusters, _SWEEP_KW
+from rifraf_tpu.io import journal as jmod
+from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+
+orig_append = jmod.Journal.append
+def append_then_die(self, record):
+    orig_append(self, record)
+    if record.get("kind") == "chunk":
+        os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, no atexit
+jmod.Journal.append = append_then_die
+sweep_clusters_sharded(_tiny_clusters(), journal_path={jp!r}, **_SWEEP_KW)
+"""
+
+
+@pytest.mark.slow
+def test_sweep_resume_after_sigkill_bit_identical(tmp_path):
+    """The acceptance scenario end to end: SIGKILL the sweep process
+    the instant its first chunk checkpoint hits the journal, then
+    resume in a fresh context — outputs bit-identical, completed work
+    not recomputed."""
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+
+    jp = str(tmp_path / "sweep.journal.jsonl")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = _KILL_CHILD.format(repo=repo,
+                               testdir=os.path.join(repo, "tests"),
+                               jp=jp)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=540)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+    records, torn = read_journal(jp)
+    chunk_records = [r for r in records if r.get("kind") == "chunk"]
+    assert len(chunk_records) == 1  # the fsync beat the SIGKILL
+
+    clusters = _tiny_clusters()
+    reference = sweep_clusters_sharded(clusters, **_SWEEP_KW)
+    resumed = sweep_clusters_sharded(clusters, journal_path=jp,
+                                     resume=True, **_SWEEP_KW)
+    _assert_results_equal(reference, resumed)
+    records, _ = read_journal(jp)
+    tasks = [r["task"] for r in records if r.get("kind") == "chunk"]
+    # at most one checkpoint interval recomputed: the killed run's
+    # completed chunk is NOT re-journaled
+    assert len(tasks) == len(set(tasks))
+
+
+# ------------------------------------------------ serve CLI spool (slow)
+
+
+def _write_reqs(path, ids, seqs=("ACGTACGTACGTACGTACGTACGT",) * 3,
+                newline=True):
+    lines = [json.dumps({"id": i, "seqs": list(seqs),
+                         "phreds": [[20] * len(s) for s in seqs]})
+             for i in ids]
+    path.write_text("\n".join(lines) + ("\n" if newline else ""))
+
+
+@pytest.mark.slow
+def test_cli_watch_hardening_and_quarantine(tmp_path):
+    """One watch-once pass over a hostile spool directory: tmp files
+    and dotfiles ignored, a partial trailing JSONL line quarantined as
+    truncated (complete lines still served), and a FASTQ spool with a
+    malformed record served through the quarantine front door."""
+    from rifraf_tpu.cli.serve import main as serve_main
+
+    _write_reqs(tmp_path / "in.jsonl", ["q0", "q1"])
+    # partial tail: last line has no newline terminator
+    _write_reqs(tmp_path / "partial.jsonl", ["p0", "p1"], newline=False)
+    (tmp_path / "skip.jsonl.tmp").write_text('{"id": "nope"}\n')
+    (tmp_path / ".hidden.jsonl").write_text('{"id": "nope"}\n')
+    fastq = (
+        "@c1/r1\nACGTACGTACGTACGTACGTACGT\n+\n" + "I" * 24 + "\n"
+        "@c1/r2\nACGTACGTACGTACGTACGTACGT\n+\n" + "I" * 24 + "\n"
+        "@badrec\nACGTN\n+\nIIIII\n"
+        "@c2/r1\nACGTACGTACGTACGTACGTACGT\n+\n" + "I" * 24 + "\n"
+    )
+    (tmp_path / "reads.fastq").write_text(fastq)
+
+    rc = serve_main(["--watch", str(tmp_path), "--watch-once",
+                     "--max-iters", "8", "--max-batch", "2"])
+    assert rc == 0
+
+    by_id = {d["id"]: d for d in (
+        json.loads(l) for l in
+        (tmp_path / "in.out.jsonl").read_text().splitlines())}
+    assert by_id["q0"]["ok"] and by_id["q1"]["ok"]
+    # ignored spool members produced no sidecars at all
+    assert not (tmp_path / "skip.out.jsonl").exists()
+    assert not (tmp_path / ".hidden.out.jsonl").exists()
+
+    # partial file: p0 (complete line) served; the torn p1 line is
+    # quarantined as truncated, not parsed, not crashed on
+    partial = {d["id"]: d for d in (
+        json.loads(l) for l in
+        (tmp_path / "partial.out.jsonl").read_text().splitlines())}
+    assert partial["p0"]["ok"] and "p1" not in partial
+    qents = [json.loads(l) for l in
+             (tmp_path / "partial.quarantine.jsonl").read_text()
+             .splitlines()]
+    assert qents[0]["reason"] == "truncated"
+
+    # FASTQ spool: per-cluster responses; the malformed record is in
+    # quarantine with its typed reason
+    fq = {d["id"]: d for d in (
+        json.loads(l) for l in
+        (tmp_path / "reads.out.jsonl").read_text().splitlines())}
+    assert fq["c1"]["ok"] and fq["c2"]["ok"]
+    assert fq["c1"]["consensus"] == "ACGTACGTACGTACGTACGTACGT"
+    fqq = [json.loads(l) for l in
+           (tmp_path / "reads.quarantine.jsonl").read_text().splitlines()]
+    assert [e["reason"] for e in fqq] == ["bad_alphabet"]
+    assert fqq[0]["name"] == "badrec"
+
+    # every served file carries a completion journal ending in "done"
+    jrecs = [json.loads(l) for l in
+             (tmp_path / "in.journal.jsonl").read_text().splitlines()]
+    assert jrecs[0]["kind"] == "header"
+    assert {r["id"] for r in jrecs if r["kind"] == "req"} == {"q0", "q1"}
+    assert jrecs[-1]["kind"] == "done"
+
+
+@pytest.mark.slow
+def test_cli_watch_resume_skips_journaled_requests(tmp_path):
+    """--resume replays the journal sidecar a killed run left behind:
+    completed ids are skipped, their outputs preserved, and only the
+    remainder is computed (appended)."""
+    from rifraf_tpu.cli.serve import main as serve_main
+
+    _write_reqs(tmp_path / "in.jsonl", ["q0", "q1", "q2"])
+    # fabricate the post-kill state: q0 journaled + its output flushed
+    jp = journal_path_for(str(tmp_path / "in.jsonl"))
+    with Journal(jp, header={"fingerprint":
+                             fingerprint("in.jsonl")}) as j:
+        j.append({"kind": "req", "id": "q0"})
+    sentinel = {"id": "q0", "ok": True, "consensus": "SENTINEL"}
+    (tmp_path / "in.out.jsonl").write_text(json.dumps(sentinel) + "\n")
+
+    rc = serve_main(["--watch", str(tmp_path), "--watch-once",
+                     "--resume", "--max-iters", "8", "--max-batch", "2"])
+    assert rc == 0
+    lines = [json.loads(l) for l in
+             (tmp_path / "in.out.jsonl").read_text().splitlines()]
+    # q0 NOT recomputed: its pre-crash output line is intact
+    assert lines[0] == sentinel
+    assert {d["id"] for d in lines[1:]} == {"q1", "q2"}
+    assert all(d["ok"] for d in lines[1:])
+    jrecs = [json.loads(l) for l in open(jp)]
+    req_ids = [r["id"] for r in jrecs if r.get("kind") == "req"]
+    assert sorted(req_ids) == ["q0", "q1", "q2"]
+    assert len(req_ids) == len(set(req_ids))
+    assert jrecs[-1]["kind"] == "done"
+
+    # a second resume pass is a no-op: the file is marked done
+    rc = serve_main(["--watch", str(tmp_path), "--watch-once",
+                     "--resume", "--max-iters", "8", "--max-batch", "2"])
+    assert rc == 0
+    assert len((tmp_path / "in.out.jsonl").read_text().splitlines()) == 3

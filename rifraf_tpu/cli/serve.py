@@ -37,7 +37,11 @@ Durability: watch mode write-ahead journals every completed request id
 to ``<stem>.journal.jsonl`` (fsync'd per response, ``io.journal``
 format); ``--resume`` replays the journals after a crash — ``kill -9``
 included — so completed requests are skipped and their files' outputs
-appended, not recomputed. ``--resume`` with ``--input FILE`` journals
+appended, not recomputed. Journals are fingerprinted against the serve
+config (error model, phred cap, deadline) and the spool file's content
+head, so a file rewritten under the same name or served under a
+different configuration is re-served from scratch rather than matched
+to its stale journal. ``--resume`` with ``--input FILE`` journals
 to the same sidecar next to FILE.
 
 ``--stats`` prints the server's metrics snapshot (queue depth, batch
@@ -48,6 +52,7 @@ counts) as JSON to stderr on exit.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import sys
@@ -184,10 +189,11 @@ class _Emitter:
     is journaled AFTER its output line is durably written — so a resume
     never skips a request whose output the crash swallowed."""
 
-    def __init__(self, fh, journal=None, on_ok=None):
+    def __init__(self, fh, journal=None, on_ok=None, on_emit=None):
         self.fh = fh
         self.journal = journal
         self.on_ok = on_ok  # called with the id of each OK response
+        self.on_emit = on_emit  # called with EVERY emitted response's id
         self.lock = threading.Lock()
         # future.result() returns once the result is SET, but the done
         # callback that emits it runs afterwards on a server thread —
@@ -224,6 +230,8 @@ class _Emitter:
                 self.journal.append({"kind": "req", "id": obj.get("id")})
             if self.on_ok is not None:
                 self.on_ok(obj.get("id"))
+        if self.on_emit is not None and obj.get("id") is not None:
+            self.on_emit(obj["id"])
 
     def emit_response(self, fut) -> None:
         try:
@@ -409,14 +417,50 @@ def watch_candidates(names) -> List[str]:
     return sorted(out)
 
 
-def _load_file_journal(path: str, resume: bool):
-    """Prior completion state of one spool file: (done_ids, finished)."""
+def _spool_fingerprint(path: str, args, config: ServeConfig) -> str:
+    """Journal fingerprint for one spool file: the serve config that
+    shapes responses (error model, phred cap, deadline, iteration
+    budget) plus a content signal — a digest of the file's first
+    64 KiB. The head digest is stable under append-growth of a large
+    JSONL spool, but a file deleted and rewritten under the same name
+    no longer matches its stale journal, so its (possibly different)
+    requests are re-served instead of silently skipped."""
+    from ..io.journal import fingerprint
+
+    head = b""
+    try:
+        with open(path, "rb") as fh:
+            head = fh.read(65536)
+    except OSError:
+        pass
+    return fingerprint(
+        os.path.basename(path), config.scores, args.phred_cap,
+        args.deadline_ms, args.max_iters, args.alignment_proposals,
+        hashlib.sha256(head).hexdigest(),
+    )
+
+
+def _load_file_journal(path: str, resume: bool, fp: str = ""):
+    """Prior completion state of one spool file: (done_ids, finished).
+    A journal whose header fingerprint does not match ``fp`` is STALE —
+    the file was rewritten or the serve config changed — so its ids are
+    dropped and the file re-served from scratch (recomputing is
+    recoverable; skipping new requests on old journal entries is not)."""
     from ..io.journal import read_journal
     from ..io.stream import journal_path_for
 
     if not resume:
         return set(), False
     records, _torn = read_journal(journal_path_for(path))
+    if not records:
+        return set(), False
+    head = records[0]
+    if head.get("kind") != "header" or \
+            (fp and head.get("fingerprint") != fp):
+        print(f"rifraf-serve: stale journal for '{path}' (file content "
+              "or serve config changed); re-serving from scratch",
+              file=sys.stderr)
+        return set(), False
     done_ids = {r.get("id") for r in records if r.get("kind") == "req"}
     finished = any(r.get("kind") == "done" for r in records)
     return done_ids, finished
@@ -426,12 +470,21 @@ class _WatchedFile:
     """Per-file serving state across polls: size stability, ids served
     so far (journal ∪ this process), and the partial-tail counter."""
 
-    def __init__(self, path: str, resume: bool):
+    def __init__(self, path: str, resume: bool, args, config):
         self.path = path
+        self.args = args
+        self.config = config
         self.last_size = -1
         self.stable = 0  # consecutive polls at last_size
         self.noeol_polls = 0  # stable polls ending without a newline
-        self.done_ids, self.finished = _load_file_journal(path, resume)
+        self.fp = _spool_fingerprint(path, args, config)
+        self.done_ids, self.finished = _load_file_journal(
+            path, resume, self.fp)
+        # ids ANSWERED in out.jsonl this process (journaled successes
+        # plus emitted failures): failures stay un-journaled so a
+        # --resume after a crash retries them, but re-polling the same
+        # file must not append duplicate ok:false lines
+        self.emitted = set(self.done_ids)
         self.journal = None
         self.out_fh = None
 
@@ -450,18 +503,23 @@ class _WatchedFile:
     def open_sinks(self, resume: bool):
         """Lazily open the output + journal sidecars (append when
         resuming with prior completions, else truncate)."""
-        from ..io.journal import fingerprint, open_resumable
+        from ..io.journal import open_resumable
         from ..io.stream import journal_path_for
 
         if self.out_fh is not None:
             return
+        resuming = resume and bool(self.done_ids)
+        if not resuming:
+            # fresh header: re-fingerprint now that the file is
+            # size-stable — its head may still have been growing when
+            # this watcher first sighted it
+            self.fp = _spool_fingerprint(self.path, self.args,
+                                         self.config)
         stem = journal_path_for(self.path)[: -len(".journal.jsonl")]
-        header = {"fingerprint":
-                  fingerprint(os.path.basename(self.path))}
         self.journal, _prior = open_resumable(
-            journal_path_for(self.path), header,
-            resume=resume and bool(self.done_ids))
-        mode = "a" if (resume and self.done_ids) else "w"
+            journal_path_for(self.path), {"fingerprint": self.fp},
+            resume=resuming)
+        mode = "a" if resuming else "w"
         self.out_fh = open(stem + ".out.jsonl", mode)
 
     def mark_done(self):
@@ -492,11 +550,14 @@ def _serve_watched_jsonl(wf: _WatchedFile, server, args, config,
     tail = None
     if not complete:
         tail = lines.pop()  # partial trailing line: re-read next poll
-    # track ids as they complete so a re-poll of a growing file only
-    # submits NEW lines
-    served_before = set(wf.done_ids)
+    # track ids as they are ANSWERED so a re-poll of a growing (or
+    # newline-less) file only submits NEW lines — the emitted set
+    # covers failures too, so a partial-tail file re-polled up to
+    # _TAIL_GIVEUP_POLLS times does not append duplicate ok:false
+    # lines (those ids stay un-journaled: a --resume run retries them)
+    served_before = wf.done_ids | wf.emitted
     emitter = _Emitter(wf.out_fh, journal=wf.journal,
-                       on_ok=wf.done_ids.add)
+                       on_ok=wf.done_ids.add, on_emit=wf.emitted.add)
     serve_stream(lines, server, emitter, args, config,
                  done_ids=served_before)
     if complete:
@@ -525,7 +586,8 @@ def _run_watch(server: ConsensusServer, args,
             path = os.path.join(args.watch, name)
             wf = files.get(name)
             if wf is None:
-                wf = files[name] = _WatchedFile(path, args.resume)
+                wf = files[name] = _WatchedFile(path, args.resume,
+                                                args, config)
             if wf.finished:
                 continue
             stable = wf.poll_size()
@@ -534,22 +596,34 @@ def _run_watch(server: ConsensusServer, args,
             is_fastq = not name.endswith(".jsonl")
             if args.verbose >= 1 and wf.out_fh is None:
                 print(f"serving '{path}'", file=sys.stderr)
-            wf.open_sinks(args.resume)
-            if is_fastq:
-                # FASTQ spools are served whole once size-stable; a
-                # truly truncated record quarantines, never crashes
-                serve_fastq(path, server,
-                            _Emitter(wf.out_fh, journal=wf.journal),
-                            args, config, done_ids=wf.done_ids)
-                wf.mark_done()
-            else:
-                if not _serve_watched_jsonl(
-                        wf, server, args, config,
-                        final=(args.watch_once
-                               or wf.noeol_polls >= _TAIL_GIVEUP_POLLS)):
-                    wf.noeol_polls += 1
-                else:
+            try:
+                wf.open_sinks(args.resume)
+                if is_fastq:
+                    # FASTQ spools are served whole once size-stable; a
+                    # truly truncated record quarantines, never crashes
+                    serve_fastq(path, server,
+                                _Emitter(wf.out_fh, journal=wf.journal,
+                                         on_ok=wf.done_ids.add),
+                                args, config, done_ids=wf.done_ids)
                     wf.mark_done()
+                else:
+                    if not _serve_watched_jsonl(
+                            wf, server, args, config,
+                            final=(args.watch_once
+                                   or wf.noeol_polls
+                                   >= _TAIL_GIVEUP_POLLS)):
+                        wf.noeol_polls += 1
+                    else:
+                        wf.mark_done()
+            except Exception as e:
+                # availability first: one poisonous spool file (an I/O
+                # error, an unwritable sidecar, a parser bug) must not
+                # take down the whole serving process
+                print(f"rifraf-serve: error serving '{path}': "
+                      f"{type(e).__name__}: {e}; file skipped",
+                      file=sys.stderr)
+                wf.finished = True
+                wf.close_sinks()
         if args.watch_once:
             for wf in files.values():
                 if not wf.finished:
@@ -576,15 +650,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     raise SystemExit(
                         "--resume needs --input FILE or --watch "
                         "(stdin has no journal sidecar)")
-                from ..io.journal import fingerprint, open_resumable
+                from ..io.journal import open_resumable
                 from ..io.stream import journal_path_for
 
+                fp = _spool_fingerprint(args.input, args, config)
                 done_ids, _finished = _load_file_journal(
-                    args.input, resume=True)
+                    args.input, resume=True, fp=fp)
                 journal, _prior = open_resumable(
                     journal_path_for(args.input),
-                    {"fingerprint":
-                     fingerprint(os.path.basename(args.input))},
+                    {"fingerprint": fp},
                     resume=bool(done_ids))
                 if done_ids:
                     out_mode = "a"

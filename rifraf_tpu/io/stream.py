@@ -41,6 +41,8 @@ import numpy as np
 
 from ..engine.validate import (
     InvalidInputError,
+    LengthMismatchError,
+    PhredRangeError,
     validate_phreds,
     validate_seq,
 )
@@ -217,7 +219,9 @@ def _stream_fastq_fh(lines: _Lines, quarantine, faults, tolerate_tail,
                                  line=lines.lineno)
             return
         seq, plus, qual = (ln.rstrip("\r\n") for ln in block)
-        name = h[1:].split()[0] if len(h) > 1 else f"seq_{index + 1}"
+        # a header of '@' (or '@' + whitespace) has no name field
+        parts = h[1:].split()
+        name = parts[0] if parts else f"seq_{index + 1}"
         if not plus.startswith("+"):
             if quarantine is not None:
                 quarantine.write(reason="malformed_record",
@@ -229,19 +233,36 @@ def _stream_fastq_fh(lines: _Lines, quarantine, faults, tolerate_tail,
             validate_seq(seq, name=name, index=index, source=source)
             if len(qual) != len(seq):
                 # empty quality strings land here too
-                from ..engine.validate import LengthMismatchError
                 raise LengthMismatchError(
                     f"quality length {len(qual)} != sequence length "
                     f"{len(seq)} (read {name!r} in {source})",
                     qual_len=len(qual), seq_len=len(seq), name=name,
                     index=index, source=source)
-            q = np.frombuffer(qual.encode("ascii", "replace"),
+            try:
+                # strict: a non-ASCII quality byte is corrupt input and
+                # must quarantine, not silently become phred 30 ('?')
+                qbytes = qual.encode("ascii")
+            except UnicodeEncodeError:
+                raise PhredRangeError(
+                    "non-ASCII quality character "
+                    f"(read {name!r} in {source})",
+                    name=name, index=index, source=source)
+            q = np.frombuffer(qbytes,
                               dtype=np.uint8).astype(np.int16) - PHRED_OFFSET
             validate_phreds(q, len(seq), name=name, index=index,
                             source=source)
         except InvalidInputError as e:
             if quarantine is not None:
                 quarantine.write(reason=e.code, message=str(e),
+                                 source=source, index=index, record=h,
+                                 name=name, line=lines.lineno)
+            continue
+        except Exception as e:
+            # the module contract: NO content-derived error escapes the
+            # parser (in serve --watch an escape kills the process)
+            if quarantine is not None:
+                quarantine.write(reason="malformed_record",
+                                 message=f"{type(e).__name__}: {e}",
                                  source=source, index=index, record=h,
                                  name=name, line=lines.lineno)
             continue

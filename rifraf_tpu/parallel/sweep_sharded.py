@@ -60,6 +60,7 @@ and weight-0 pad reads/clusters drop out of every reduction.
 from __future__ import annotations
 
 import functools
+import hashlib
 import os
 import threading
 import time
@@ -230,6 +231,26 @@ def cluster_info(cluster: Sequence[ReadScores]) -> _ClusterInfo:
     """Per-cluster shape/seed facts for ONE cluster (the serving
     admission path computes these once per request)."""
     return _cluster_infos([cluster])[0]
+
+
+def _content_digest(clusters: Sequence[Sequence[ReadScores]]) -> str:
+    """Digest of the cluster CONTENT for the resume fingerprint. Shape
+    facts (_ClusterInfo) alone cannot distinguish edited read/phred
+    content of the same lengths, or a different error model — resuming
+    across either would silently mix two configurations' results. The
+    score vectors are all derived from (seq, error_log_p, scores), so
+    hashing those plus the bandwidth state covers everything the sweep
+    computes from."""
+    h = hashlib.sha256()
+    for c in clusters:
+        for r in c:
+            h.update(np.ascontiguousarray(r.seq).tobytes())
+            h.update(np.ascontiguousarray(r.error_log_p).tobytes())
+            h.update(repr((r.scores, r.bandwidth,
+                           r.bandwidth_fixed)).encode())
+            h.update(b"\x00")
+        h.update(b"\x01")
+    return h.hexdigest()[:32]
 
 
 def bucket_key(
@@ -1120,7 +1141,8 @@ def sweep_clusters_sharded(
         from ..utils.constants import encode_seq
 
         fp = fingerprint(
-            G, [tuple(i) for i in infos], max_iters, min_dist,
+            G, [tuple(i) for i in infos], _content_digest(clusters),
+            max_iters, min_dist,
             bandwidth_pvalue, len_bucket, cluster_chunk, scheduler,
             read_bucket, band_bucket, do_alignment_proposals,
             lane_target, segment_pack, segment_align,

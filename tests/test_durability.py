@@ -168,6 +168,12 @@ _CASES = {
     "bad_header": ("garbage line\n", 0, ["malformed_record"]),
     "empty_read": ("@b\n\n+\n\n", 0, ["zero_length_read"]),
     "blank": ("\n", 0, []),
+    # '@' alone or followed by only whitespace: no name field — the
+    # record is otherwise well-formed and gets a synthesized name
+    "bare_at": ("@\nACGT\n+\nIIII\n", 1, []),
+    "ws_header": ("@ \t \nACGT\n+\nIIII\n", 1, []),
+    # a non-ASCII quality byte must quarantine, not silently map to '?'
+    "nonascii_qual": ("@b\nACGT\n+\nII\xffI\n", 0, ["phred_range"]),
 }
 
 
@@ -309,6 +315,42 @@ def test_fingerprint_stable_and_discriminating():
     assert a != fingerprint(1, [("x", 3)], "bucketed")
 
 
+def test_sweep_content_digest_sees_content_not_just_shapes():
+    """The sweep resume fingerprint must distinguish clusters whose
+    SHAPES match but whose read/phred content or error model differs —
+    shape-only fingerprints would let --resume silently mix results
+    journaled under a different configuration."""
+    import dataclasses
+
+    from rifraf_tpu.models.errormodel import Scores
+    from rifraf_tpu.models.sequences import make_read_scores
+    from rifraf_tpu.parallel.sweep_sharded import _content_digest
+    from rifraf_tpu.utils.phred import phred_to_log_p
+
+    scores = Scores(-3.0, -4.0, -4.0)
+
+    def mk(seq, phred=20):
+        log_p = phred_to_log_p(np.full(len(seq), float(phred)))
+        return make_read_scores(seq, log_p, 5, scores)
+
+    base = [[mk("ACGTACGT"), mk("ACGTACGT")]]
+    assert _content_digest(base) == \
+        _content_digest([[mk("ACGTACGT"), mk("ACGTACGT")]])
+    # same lengths, different base content
+    assert _content_digest(base) != _content_digest(
+        [[mk("ACGTACGA"), mk("ACGTACGT")]])
+    # same sequences, different phreds
+    assert _content_digest(base) != _content_digest(
+        [[mk("ACGTACGT", phred=30), mk("ACGTACGT")]])
+    # same reads, different error-model scores
+    swapped = [[dataclasses.replace(r, scores=Scores(-2.0, -4.0, -4.0))
+                for r in base[0]]]
+    assert _content_digest(base) != _content_digest(swapped)
+    # cluster boundaries matter: [r1, r2] vs [r1], [r2]
+    assert _content_digest([[mk("ACGT"), mk("ACGT")]]) != \
+        _content_digest([[mk("ACGT")], [mk("ACGT")]])
+
+
 # ----------------------------------------------------- watch-spool rules
 
 
@@ -328,17 +370,56 @@ def test_load_file_journal(tmp_path):
 
     path = str(tmp_path / "in.jsonl")
     jp = journal_path_for(path)
-    with Journal(jp, header={"fingerprint": fingerprint("in.jsonl")}) as j:
+    fp = fingerprint("in.jsonl")
+    with Journal(jp, header={"fingerprint": fp}) as j:
         j.append({"kind": "req", "id": "q0"})
         j.append({"kind": "req", "id": "q1"})
-    done, finished = _load_file_journal(path, resume=True)
+    done, finished = _load_file_journal(path, resume=True, fp=fp)
     assert done == {"q0", "q1"} and not finished
     with Journal(jp, resume=True) as j:
         j.append({"kind": "done", "n": 2})
-    done, finished = _load_file_journal(path, resume=True)
+    done, finished = _load_file_journal(path, resume=True, fp=fp)
     assert finished
+    # a stale journal (file rewritten / config changed => fingerprint
+    # mismatch) is dropped: re-serve from scratch, don't skip new work
+    assert _load_file_journal(path, resume=True, fp="OTHER") == \
+        (set(), False)
     # resume off: prior journals are ignored
     assert _load_file_journal(path, resume=False) == (set(), False)
+
+
+def test_spool_fingerprint_tracks_config_and_content(tmp_path):
+    """The watch journal fingerprint must change when the spool file is
+    rewritten (same name, different content) or the serve config
+    (error model, phred cap, deadline) changes — but stay stable under
+    pure append-growth of a large spool."""
+    from rifraf_tpu.cli.serve import (
+        _spool_fingerprint,
+        build_parser,
+        config_from_args,
+    )
+
+    path = tmp_path / "in.jsonl"
+    path.write_text('{"id": "a"}\n')
+
+    def fp(*argv):
+        args = build_parser().parse_args(list(argv))
+        return _spool_fingerprint(str(path), args, config_from_args(args))
+
+    base = fp()
+    assert base == fp()
+    assert base != fp("--seq-errors", "3,1,1")
+    assert base != fp("--phred-cap", "30")
+    assert base != fp("--deadline-ms", "100")
+    # rewritten under the same name: different fingerprint
+    path.write_text('{"id": "ZZ"}\n')
+    assert fp() != base
+    # append-growth past the 64 KiB head window: fingerprint stable
+    path.write_text("x" * 70000)
+    grown = fp()
+    with open(path, "a") as fh:
+        fh.write("y" * 1000)
+    assert fp() == grown
 
 
 # ------------------------------------------------- resume grid (slow)
@@ -432,6 +513,18 @@ def test_sweep_resume_after_crash_recomputes_one_interval(
         sweep_clusters_sharded(clusters, journal_path=jp, resume=True,
                                cluster_chunk=3, lane_target=0,
                                segment_pack=False)
+    # edited CONTENT with identical shapes must also refuse: the shape
+    # facts alone cannot tell these inputs from the journaled ones
+    import dataclasses
+
+    edited = [list(c) for c in clusters]
+    r0 = edited[0][0]
+    lp = r0.error_log_p.copy()
+    lp[0] -= 0.1
+    edited[0][0] = dataclasses.replace(r0, error_log_p=lp)
+    with pytest.raises(JournalError, match="fingerprint"):
+        sweep_clusters_sharded(edited, journal_path=jp, resume=True,
+                               **_SWEEP_KW)
 
 
 _KILL_CHILD = r"""
@@ -568,13 +661,22 @@ def test_cli_watch_resume_skips_journaled_requests(tmp_path):
     """--resume replays the journal sidecar a killed run left behind:
     completed ids are skipped, their outputs preserved, and only the
     remainder is computed (appended)."""
+    from rifraf_tpu.cli.serve import (
+        _spool_fingerprint,
+        build_parser,
+        config_from_args,
+    )
     from rifraf_tpu.cli.serve import main as serve_main
 
     _write_reqs(tmp_path / "in.jsonl", ["q0", "q1", "q2"])
     # fabricate the post-kill state: q0 journaled + its output flushed
+    argv = ["--watch", str(tmp_path), "--watch-once", "--resume",
+            "--max-iters", "8", "--max-batch", "2"]
+    args = build_parser().parse_args(argv)
+    fp = _spool_fingerprint(str(tmp_path / "in.jsonl"), args,
+                            config_from_args(args))
     jp = journal_path_for(str(tmp_path / "in.jsonl"))
-    with Journal(jp, header={"fingerprint":
-                             fingerprint("in.jsonl")}) as j:
+    with Journal(jp, header={"fingerprint": fp}) as j:
         j.append({"kind": "req", "id": "q0"})
     sentinel = {"id": "q0", "ok": True, "consensus": "SENTINEL"}
     (tmp_path / "in.out.jsonl").write_text(json.dumps(sentinel) + "\n")
@@ -599,3 +701,79 @@ def test_cli_watch_resume_skips_journaled_requests(tmp_path):
                      "--resume", "--max-iters", "8", "--max-batch", "2"])
     assert rc == 0
     assert len((tmp_path / "in.out.jsonl").read_text().splitlines()) == 3
+
+
+@pytest.mark.slow
+def test_cli_watch_stale_journal_reserved_not_skipped(tmp_path):
+    """A journal left by a DIFFERENT file under the same name (deleted
+    and rewritten spool) or a different serve config must not match:
+    the file is re-served from scratch instead of its new requests
+    being silently skipped against stale journal ids."""
+    from rifraf_tpu.cli.serve import main as serve_main
+
+    _write_reqs(tmp_path / "in.jsonl", ["q0", "q1"])
+    jp = journal_path_for(str(tmp_path / "in.jsonl"))
+    # a stale journal: fingerprint of some other file/config epoch that
+    # claims q0 and q1 are already done
+    with Journal(jp, header={"fingerprint": "stale-epoch"}) as j:
+        j.append({"kind": "req", "id": "q0"})
+        j.append({"kind": "req", "id": "q1"})
+        j.append({"kind": "done", "n": 2})
+    (tmp_path / "in.out.jsonl").write_text('{"id": "q0", "ok": true}\n')
+
+    rc = serve_main(["--watch", str(tmp_path), "--watch-once",
+                     "--resume", "--max-iters", "8", "--max-batch", "2"])
+    assert rc == 0
+    lines = [json.loads(l) for l in
+             (tmp_path / "in.out.jsonl").read_text().splitlines()]
+    # both requests recomputed; the stale output was truncated
+    assert {d["id"] for d in lines} == {"q0", "q1"}
+    assert all(d["ok"] and "consensus" in d for d in lines)
+    jrecs = [json.loads(l) for l in open(jp)]
+    assert jrecs[0]["fingerprint"] != "stale-epoch"
+
+
+@pytest.mark.slow
+def test_watch_repoll_does_not_duplicate_failed_responses(tmp_path):
+    """Re-polling a size-stable file whose tail lacks a newline must
+    not re-serve (and re-append duplicate ok:false lines for) requests
+    that already failed this process — while leaving failures
+    un-journaled so a post-crash --resume retries them."""
+    from rifraf_tpu.cli.serve import (
+        _WatchedFile,
+        _serve_watched_jsonl,
+        build_parser,
+        config_from_args,
+    )
+    from rifraf_tpu.serve import ConsensusServer
+
+    args = build_parser().parse_args(
+        ["--watch", str(tmp_path), "--max-iters", "8",
+         "--max-batch", "2"])
+    config = config_from_args(args)
+    path = tmp_path / "in.jsonl"
+    good = json.dumps({"id": "q0",
+                       "seqs": ["ACGTACGTACGTACGTACGTACGT"] * 3,
+                       "phreds": [[20] * 24] * 3})
+    bad = json.dumps({"id": "b0", "seqs": ["ACGT"]})  # no phreds/quals
+    path.write_text(good + "\n" + bad + "\n" + '{"id": "tail"')
+
+    server = ConsensusServer(config)
+    try:
+        wf = _WatchedFile(str(path), False, args, config)
+        wf.open_sinks(False)
+        assert not _serve_watched_jsonl(wf, server, args, config,
+                                        final=False)
+        assert not _serve_watched_jsonl(wf, server, args, config,
+                                        final=False)
+        wf.close_sinks()
+    finally:
+        server.close()
+    lines = [json.loads(l) for l in
+             (tmp_path / "in.out.jsonl").read_text().splitlines()]
+    # exactly one response per complete line across BOTH polls
+    assert sorted(d["id"] for d in lines) == ["b0", "q0"]
+    assert not next(d for d in lines if d["id"] == "b0")["ok"]
+    # the failure is not journaled: a --resume run would retry it
+    jrecs = [json.loads(l) for l in open(journal_path_for(str(path)))]
+    assert {r["id"] for r in jrecs if r.get("kind") == "req"} == {"q0"}

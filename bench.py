@@ -52,6 +52,12 @@ Other modes (results appended to BASELINE.md, not the driver JSON):
                availability, p99, and restart counts (--serve-n
                overrides the request count for smoke runs; slow-only
                in CI)
+  --precision  f32 vs bf16 band store (params.band_dtype) on the
+               headline and ref-default configs: seconds, modeled
+               band/total byte reduction at the 1 kb x 256 fused-step
+               shape, pct_hbm_roof when dispatches record, and the
+               consensus-identity + template-recovery gates
+               (--precision-timed overrides the timed-run count)
   --multichip  mesh scale-out: the north-star consensus with its read
                axis sharded over 1/2/4/8-device meshes (wall, identity
                vs the unsharded oracle, modeled ICI-aware efficiency)
@@ -110,7 +116,7 @@ def build_e2e_problem(tlen=TLEN, n_reads=N_READS, seed=0, error_rate=0.01):
 
 
 def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
-            device_loop=None, do_score=False):
+            device_loop=None, do_score=False, band_dtype=None):
     """One full consensus; returns (wall_seconds, result)."""
     from rifraf_tpu.engine.driver import rifraf
     from rifraf_tpu.engine.params import RifrafParams
@@ -143,6 +149,8 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
         kw["device_loop"] = device_loop
     if do_score:
         kw["do_score"] = True
+    if band_dtype is not None:
+        kw["band_dtype"] = band_dtype
     params = RifrafParams(max_iters=max_iters, **kw)
     t0 = time.perf_counter()
     result = rifraf(seqs, phreds=phreds, params=params)
@@ -151,14 +159,15 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
 
 def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
                 max_iters=100, verbose=False, ref_default=False,
-                device_loop=None, do_score=False):
+                device_loop=None, do_score=False, band_dtype=None):
     template, seqs, phreds = build_e2e_problem(tlen, n_reads)
     walls = []
     result = None
     for i in range(n_timed + 1):  # first run compiles
         wall, result = run_e2e(seqs, phreds, bandwidth=bandwidth,
                                max_iters=max_iters, ref_default=ref_default,
-                               device_loop=device_loop, do_score=do_score)
+                               device_loop=device_loop, do_score=do_score,
+                               band_dtype=band_dtype)
         if verbose:
             label = "compile+run" if i == 0 else "warm"
             print(f"  run {i}: {wall:.2f}s ({label})", file=sys.stderr)
@@ -203,7 +212,8 @@ def roofline_stats(result):
         "pct_hbm_roof": round(u["pct_hbm"], 1),
         "hbm_roof_gbps": roofline.HBM_GBPS,
         "plan": {"T1p": r["T1p"], "K": r["K"], "C": r["C"],
-                 "Npad": r["Npad"]},
+                 "Npad": r["Npad"],
+                 "band_dtype": r.get("band_dtype", "f32")},
     }
 
 
@@ -418,9 +428,96 @@ def _sweep_roofline(plans, results, seconds):
     }
 
 
+def _precision_mode():
+    """f32 vs bf16 band store on the same seeded problems: wall
+    seconds, the MODELED band-byte reduction (deterministic — the
+    dtype lever is a byte-wall story, so the roofline model is the
+    honest metric on any backend), measured pct_hbm_roof when Pallas
+    dispatches record, and the consensus gates: planted-template
+    recovery at both precisions plus bf16 == f32 consensus identity.
+    Covers the headline 1 kb x 256 full-batch config and
+    ref_default_1kb_256 (--precision-timed overrides the timed-run
+    count for smoke runs)."""
+    import jax
+
+    from rifraf_tpu.utils import roofline
+
+    n_timed = 2
+    if "--precision-timed" in sys.argv:
+        n_timed = int(sys.argv[sys.argv.index("--precision-timed") + 1])
+
+    out = {"config": "precision_f32_vs_bf16",
+           "backend": jax.default_backend()}
+    shape = None
+    for name, kw in (
+        ("e2e_1kb_256", {}),
+        ("ref_default_1kb_256", {"ref_default": True}),
+    ):
+        block = {}
+        cons = {}
+        for bd in ("f32", "bf16"):
+            roofline.clear()
+            walls, n_iters, recovered, result = measure_e2e(
+                n_timed=n_timed, band_dtype=bd, **kw)
+            cons[bd] = result.consensus.tolist()
+            block[bd] = {
+                "seconds": round(min(walls), 3),
+                "runs_s": [round(w, 3) for w in walls],
+                "n_iters": n_iters,
+                "recovered": recovered,
+            }
+            rl = roofline_stats(result)
+            if rl:
+                block[bd]["pct_hbm_roof"] = rl["pct_hbm_roof"]
+                block[bd]["model_gb_per_dispatch"] = (
+                    rl["model_gb_per_dispatch"]
+                )
+            if name == "e2e_1kb_256":
+                recs = [r for r in roofline.snapshot()
+                        if r["kernel"] == "fused_step"]
+                if recs:
+                    r = recs[-1]
+                    shape = (r["T1p"], r["K"], r["C"], r["Npad"])
+        block["consensus_identical"] = cons["f32"] == cons["bf16"]
+        block["bf16_speedup"] = round(
+            block["f32"]["seconds"] / block["bf16"]["seconds"], 2
+        )
+        out[name] = block
+
+    # modeled byte reduction at the 1 kb x 256 fused-step shape (from
+    # the recorded dispatch when the run routed through a recording
+    # path, else the config's canonical plan) — independent of backend
+    # and timer noise. Band terms halve (2 bytes vs 4); tables, tiles,
+    # and move codes stay f32/int32, so the TOTAL reduction reports how
+    # band-dominated the shape actually is.
+    if shape is None:
+        from rifraf_tpu.utils.shapes import plan_cols
+
+        T1p, K, Npad = 1024, 64, 256
+        C = plan_cols(T1p, K, "fill").cols
+        shape = (T1p, K, C, Npad)
+    T1p, K, C, Npad = shape
+    m = {
+        isz: roofline.fused_mega_model(T1p, K, Npad, C,
+                                       band_itemsize=isz)
+        for isz in (4, 2)
+    }
+    out["model_shape"] = {"T1p": T1p, "K": K, "C": C, "Npad": Npad}
+    out["modeled_band_byte_reduction"] = round(
+        1.0 - m[2]["band_bytes"] / m[4]["band_bytes"], 4
+    )
+    out["modeled_total_byte_reduction"] = round(
+        1.0 - m[2]["bytes"] / m[4]["bytes"], 4
+    )
+    print(json.dumps(out))
+
+
 def _sweep_mode():
     """Heterogeneous multi-cluster sweep: bucketed vs uniform scheduler
-    (parallel.sweep_sharded), same inputs, bit-identical results."""
+    (parallel.sweep_sharded), same inputs, bit-identical results; plus
+    the adaptive band-growth policy vs the doubling reference on a
+    length-proportional-bandwidth rebuild of the same reads (settled
+    band mass, consensus identity)."""
     import jax
 
     from rifraf_tpu.engine.params import RifrafParams
@@ -442,6 +539,7 @@ def _sweep_mode():
     params = RifrafParams()
     seq_errors = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
     clusters = []
+    raw = []  # (seq, error_log_p) pairs per cluster, for rebuilds below
     for _ in range(n_clusters):
         # log-normal template lengths and ragged cluster sizes: the
         # realistic amplicon mix whose pad-to-global-maxima cost the
@@ -452,10 +550,13 @@ def _sweep_mode():
             nseqs=nseqs, length=tlen, error_rate=0.02, rng=rng,
             seq_errors=seq_errors,
         )
-        clusters.append([
-            make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
-                             params.bandwidth, params.scores)
+        raw.append([
+            (s, phred_to_log_p(np.asarray(p, float)))
             for s, p in zip(seqs, phreds)
+        ])
+        clusters.append([
+            make_read_scores(s, lp, params.bandwidth, params.scores)
+            for s, lp in raw[-1]
         ])
 
     mesh = make_mesh() if len(jax.devices()) > 1 else None
@@ -504,6 +605,46 @@ def _sweep_mode():
     out["results_identical"] = all(
         np.array_equal(a.consensus, b.consensus) and a.score == b.score
         for a, b in zip(results["bucketed"], results["uniform"])
+    )
+
+    # ---- adaptive band growth vs the doubling reference ----
+    # Rebuild the same reads with a length-proportional caller
+    # bandwidth (max(default, len/10) — the conservative default of a
+    # caller that does not know its error rate): the configuration the
+    # adaptive policy exists for. Adaptive enters at min(bw, 16) and
+    # grows only wall-riding reads by their measured deficit, so its
+    # settled band mass should sit well under doubling's; consensus
+    # must be identical.
+    bw_clusters = [
+        [make_read_scores(s, lp, max(params.bandwidth, len(s) // 10),
+                          params.scores)
+         for s, lp in c]
+        for c in raw
+    ]
+
+    def _mean_bw(hist):
+        tot = sum(cnt for _, cnt in hist)
+        return (
+            sum(b * cnt for b, cnt in hist) / tot if tot else 0.0
+        )
+
+    growth_res = {}
+    for bg in ("double", "adaptive"):
+        sweep_clusters_sharded(bw_clusters, mesh=mesh,
+                               cluster_chunk=chunk, band_growth=bg)
+        res_g, stats_g = sweep_clusters_sharded(
+            bw_clusters, mesh=mesh, cluster_chunk=chunk, band_growth=bg,
+            return_stats=True,
+        )
+        growth_res[bg] = res_g
+        out[f"{bg}_growth_seconds"] = round(stats_g.seconds, 3)
+        out[f"{bg}_mean_bw"] = round(_mean_bw(stats_g.bw_hist), 2)
+    out["adaptive_bw_ratio"] = round(
+        out["adaptive_mean_bw"] / out["double_mean_bw"], 3
+    ) if out["double_mean_bw"] else 1.0
+    out["adaptive_results_identical"] = all(
+        np.array_equal(a.consensus, b.consensus)
+        for a, b in zip(growth_res["adaptive"], growth_res["double"])
     )
     print(json.dumps(out))
 
@@ -904,6 +1045,9 @@ def main():
         return 0
     if "--sweep" in sys.argv:
         _sweep_mode()
+        return 0
+    if "--precision" in sys.argv:
+        _precision_mode()
         return 0
     if "--serve" in sys.argv:
         _serve_mode()

@@ -108,6 +108,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--phred-cap", type=int, default=0,
                    help="maximum PHRED score (0 = no cap)")
     p.add_argument("--max-iters", type=int, default=100)
+    p.add_argument("--band-dtype", default="f32",
+                   choices=("f32", "bf16"),
+                   help="band-table storage precision: f32 is "
+                        "bit-identical to the reference; bf16 halves "
+                        "band HBM traffic (accumulation stays f32, see "
+                        "docs/api.md Precision modes)")
+    p.add_argument("--band-growth", default="double",
+                   choices=("double", "adaptive"),
+                   help="bandwidth adaptation policy: double the "
+                        "flagged reads' bands (reference), or grow "
+                        "each read by its measured band-edge deficit "
+                        "(adaptive; smaller settled bands)")
     p.add_argument("--alignment-proposals", action="store_true",
                    help="use the full single-indel proposal pass instead "
                         "of the seeded edits gate")
@@ -149,6 +161,8 @@ def config_from_args(args) -> ServeConfig:
         max_iters=args.max_iters,
         do_alignment_proposals=args.alignment_proposals,
         n_workers=max(1, args.workers),
+        band_dtype=args.band_dtype,
+        band_growth=args.band_growth,
     )
     if args.seq_errors:
         kw["scores"] = parse_error_model(args.seq_errors)
@@ -437,6 +451,7 @@ def _spool_fingerprint(path: str, args, config: ServeConfig) -> str:
         os.path.basename(path), config.scores, args.phred_cap,
         args.deadline_ms, args.max_iters, args.alignment_proposals,
         hashlib.sha256(head).hexdigest(),
+        config.band_dtype, config.band_growth,
     )
 
 

@@ -1,0 +1,131 @@
+"""Per-read bandwidth growth policies — the ONE copy of the decision
+both adaptation loops run (engine.realign._maybe_grow_bandwidth on flat
+[N] arrays, parallel.sweep_sharded.ChunkExecutor on [G, N] cluster
+matrices; pure elementwise numpy, so both shapes ride the same code).
+
+Two policies:
+
+- ``"double"`` (default): the reference port — every flagged read's
+  bandwidth doubles, capped at ``entry_bw << MAX_BANDWIDTH_DOUBLINGS``
+  (and the read/template lengths). Bit-identical to the historical
+  per-read loop.
+- ``"adaptive"`` (WFA-style, PAPERS.md "High-throughput Pairwise
+  Alignment with the Wavefront Algorithm"): growth is driven by WHERE
+  the score frontier hits the band wall. ``edge_hits`` counts the
+  optimal path's cells pinned to a band-limit row (ops.align_jax
+  ``want_edge`` / the stats kernels' acc row 2); a read whose path
+  never touches the wall is NOT band-limited — more band cannot change
+  its alignment — so a flagged read with zero hits fixes immediately
+  instead of doubling. A wall-riding read grows by the measured
+  deficit: about half the pinned run (each extra diagonal of band
+  absorbs two pinned cells of slack), rounded UP to the 8-row K grid
+  the band frames bucket on, and never more than the blunt policy's
+  x2. Well-behaved reads keep small bandwidths, so heterogeneous-K
+  re-bucketing (plan_sweep) can ride K for bandwidth 9-16 instead of
+  the worst read's band.
+
+A read is FLAGGED for growth exactly as the reference decides it
+(model.jl:716): its traceback error count exceeds the Poisson
+threshold, is still improving (dropped since the previous round), and
+its bandwidth has room under the cap. Everything else fixes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# growth cap: entry bandwidth << 5, the reference's limit (realign.py
+# and sweep_sharded.py import their module-level copies from here)
+MAX_BANDWIDTH_DOUBLINGS = 5
+
+# adaptive mode enters the loop at min(entry, ADAPTIVE_ENTRY_BW): the
+# whole point is that most reads never needed the caller's default band
+# (the driver's 10% of read length), and the policy grows the few that
+# did — entry rides the smallest useful K bucket instead
+ADAPTIVE_ENTRY_BW = 16
+
+BAND_GROWTH_POLICIES = ("double", "adaptive")
+
+
+def check_band_growth(band_growth: str) -> str:
+    if band_growth not in BAND_GROWTH_POLICIES:
+        raise ValueError(
+            f"band_growth must be one of {BAND_GROWTH_POLICIES}, "
+            f"got {band_growth!r}"
+        )
+    return band_growth
+
+
+def adaptive_entry(bandwidths):
+    """Entry bandwidths for the adaptive policy: the caller's request
+    capped at ADAPTIVE_ENTRY_BW (element-wise; never raises a smaller
+    request)."""
+    return np.minimum(np.asarray(bandwidths), ADAPTIVE_ENTRY_BW).astype(
+        np.asarray(bandwidths).dtype
+    )
+
+
+def _bucket8(x):
+    """Round up to the 8-row sublane grid the band heights bucket on."""
+    return ((x + 7) // 8) * 8
+
+
+def grow_bandwidths(
+    bandwidths,  # int array, current per-read bandwidths (any shape)
+    fixed,  # bool array, reads already settled
+    old_errors,  # int array, previous round's error counts
+    n_errors,  # int array, this round's traceback error counts
+    thresholds,  # Poisson flag thresholds (same shape or broadcastable)
+    entry_bw,  # int array, the ORIGINAL entry bandwidths (pre-lowering)
+    tlen,  # template lengths (broadcastable)
+    slen,  # read lengths (broadcastable)
+    band_growth: str = "double",
+    edge_hits=None,  # int array, band-edge hit counts (adaptive only)
+):
+    """One adaptation round's growth decision, vectorized.
+
+    Returns ``(new_bandwidths, new_fixed, new_old_errors)`` — fresh
+    arrays, inputs untouched. The growth cap is always
+    ``min(entry_bw << MAX_BANDWIDTH_DOUBLINGS, tlen, slen)`` with the
+    ORIGINAL entry bandwidths, so adaptive's lowered entry never lowers
+    the ceiling below the blunt policy's."""
+    bandwidths = np.asarray(bandwidths)
+    fixed = np.asarray(fixed, bool)
+    old_errors = np.asarray(old_errors)
+    n_errors = np.asarray(n_errors)
+
+    max_bw = np.minimum(
+        np.minimum(
+            np.asarray(entry_bw).astype(np.int64) << MAX_BANDWIDTH_DOUBLINGS,
+            tlen,
+        ),
+        slen,
+    )
+    flagged = (
+        (~fixed)
+        & (n_errors > thresholds)
+        & (n_errors < old_errors)
+        & (bandwidths < max_bw)
+    )
+
+    if band_growth == "double":
+        grow = flagged
+        growth = bandwidths  # x2
+    elif band_growth == "adaptive":
+        if edge_hits is None:
+            raise ValueError("adaptive growth requires edge_hits")
+        edge_hits = np.asarray(edge_hits)
+        # flagged reads whose path never rode the wall are error-bound,
+        # not band-bound: growing them re-runs the same alignment
+        grow = flagged & (edge_hits > 0)
+        deficit = _bucket8(np.maximum((edge_hits + 1) // 2, 1))
+        growth = np.minimum(bandwidths, deficit)  # never beyond x2
+    else:
+        check_band_growth(band_growth)
+
+    new_bw = np.where(
+        grow, np.minimum(bandwidths + growth, max_bw), bandwidths
+    ).astype(bandwidths.dtype)
+    new_fixed = fixed | ~grow
+    new_old = np.where(grow, n_errors, old_errors).astype(old_errors.dtype)
+    return new_bw, new_fixed, new_old

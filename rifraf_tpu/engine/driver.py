@@ -332,7 +332,8 @@ def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
             state.aligner = BatchAligner(
                 state.batch_seqs, dtype=params.dtype,
                 len_bucket=params.len_bucket, mesh=params.mesh,
-                backend=params.backend,
+                backend=params.backend, band_dtype=params.band_dtype,
+                band_growth=params.band_growth,
             )
         else:
             state.aligner.set_batch(state.batch_seqs)

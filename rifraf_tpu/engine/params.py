@@ -120,6 +120,18 @@ class RifrafParams:
     # stage at verbose >= 1 and surfaced in RifrafResult.metadata
     # ["stage_paths"].
     device_loop: str = "auto"
+    # HBM store dtype of the banded DP tables (forward/backward bands
+    # and the megakernel's launch-private band scratch). "f32" (default)
+    # is bit-identical to the oracle; "bf16" halves band bytes — every
+    # max-plus accumulation, rescoring sum, and convergence total still
+    # runs in f32 (store-narrow / accumulate-wide), so results are
+    # accuracy-bounded, not bit-bounded (docs/api.md "Precision modes").
+    band_dtype: str = "f32"
+    # bandwidth-adaptation policy (engine.bandgrowth): "double" ports
+    # the reference's blunt x2 growth; "adaptive" grows only reads
+    # whose traceback path rides the band wall, by the measured deficit
+    # on the 8-row K grid, entering at min(bandwidth, 16)
+    band_growth: str = "double"
 
 
 def resolve_dtype(dtype) -> np.dtype:
@@ -195,4 +207,11 @@ def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> No
         raise ValueError("batch_threshold must be between 0.0 and 1.0")
     if params.device_loop not in ("auto", "on", "off"):
         raise ValueError(f"unknown device_loop: {params.device_loop!r}")
+    if params.band_dtype not in ("f32", "bf16"):
+        raise ValueError(
+            f"band_dtype must be 'f32' or 'bf16', got {params.band_dtype!r}"
+        )
+    from .bandgrowth import check_band_growth
+
+    check_band_growth(params.band_growth)
     validate_backend(params.backend, params.dtype, params.mesh)

@@ -33,11 +33,15 @@ from ..utils.debug import myassert
 from ..utils.mathops import poisson_cquantile
 from ..utils.shapes import bucket as _bucket
 from ..utils.timers import Timers
+from .bandgrowth import (
+    MAX_BANDWIDTH_DOUBLINGS,  # noqa: F401  (re-exported; model.jl:650 cap)
+    adaptive_entry,
+    check_band_growth,
+    grow_bandwidths,
+)
 from .params import resolve_dtype, validate_backend
 from .proposals import Proposal
 from .scoring_np import score_proposal as score_proposal_np
-
-MAX_BANDWIDTH_DOUBLINGS = 5  # model.jl:650: bandwidth * 2^5 cap
 
 
 def _pallas_interpret() -> bool:
@@ -52,9 +56,23 @@ def _pallas_interpret() -> bool:
 _BYTES_PER_CELL = 22  # A+B f32, moves int8, ~2 transient copies
 
 
+def _band_itemsize(band_dtype: str) -> int:
+    """HBM bytes per band cell for the store dtype (params.band_dtype)."""
+    return 2 if band_dtype == "bf16" else 4
+
+
+def _bw_hist(bandwidths) -> tuple:
+    """Compact per-read bandwidth histogram ((bw, count), ...) for the
+    roofline registry and BENCH lines — the adaptive policy's win shows
+    up here as mass staying on small bandwidths."""
+    vals, counts = np.unique(np.asarray(bandwidths), return_counts=True)
+    return tuple((int(v), int(c)) for v, c in zip(vals, counts))
+
+
 def _dense_cols(T1p: int, K: int, Npad: int = 0,
                 want_stats: bool = False, impl: str = "split",
-                n_live: int = 0) -> int:
+                n_live: int = 0, band_dtype: str = "f32",
+                bw_hist=None) -> int:
     """Column block for the fused/dense Pallas dispatches via the shared
     VMEM planner (utils.shapes.plan_cols), recording the block plan and
     modelled HBM traffic so bench/diagnostics can report roofline
@@ -73,22 +91,27 @@ def _dense_cols(T1p: int, K: int, Npad: int = 0,
                      want_moves=impl == "mega" and want_stats)
     C = 8 if _pallas_interpret() else plan.cols
     if Npad:
+        isz = _band_itemsize(band_dtype)
         if impl == "mega":
             model = roofline.fused_mega_model(T1p, K, Npad, C,
-                                              want_stats=want_stats)
+                                              want_stats=want_stats,
+                                              band_itemsize=isz)
         else:
             model = roofline.fused_model(T1p, K, Npad, C,
-                                         want_stats=want_stats)
+                                         want_stats=want_stats,
+                                         band_itemsize=isz)
         roofline.record(
             "fused_step", T1p=T1p, K=K, Npad=Npad, C=C, impl=impl,
             vmem_bytes=plan.vmem_bytes, model_bytes=model["bytes"],
             model_ops=model["ops"], want_stats=want_stats,
             lane_occupancy=(n_live / Npad) if n_live else None,
+            band_dtype=band_dtype, bw_hist=bw_hist,
         )
     return C
 
 
-def _fill_cols(T1p: int, K: int, Npad: int = 0) -> int:
+def _fill_cols(T1p: int, K: int, Npad: int = 0, band_dtype: str = "f32",
+               bw_hist=None) -> int:
     """Column block for the forward-only fill+stats dispatch (adapt
     rounds): the fill plan must also hold the int32 move block in VMEM
     (want_moves=True)."""
@@ -99,13 +122,15 @@ def _fill_cols(T1p: int, K: int, Npad: int = 0) -> int:
     C = 8 if _pallas_interpret() else plan.cols
     if Npad:
         f = roofline.fill_model(T1p, K, Npad, C, n_streams=1,
-                                want_moves=True, moves_lanes=Npad)
+                                want_moves=True, moves_lanes=Npad,
+                                band_itemsize=_band_itemsize(band_dtype))
         s = roofline.stats_model(T1p, K, Npad, C)
         roofline.record(
             "fill_stats", T1p=T1p, K=K, Npad=Npad, C=C,
             vmem_bytes=plan.vmem_bytes,
             model_bytes=f["bytes"] + s["bytes"],
             model_ops=f["ops"] + s["ops"],
+            band_dtype=band_dtype, bw_hist=bw_hist,
         )
     return C
 
@@ -169,18 +194,36 @@ class BatchAligner:
     """
 
     def __init__(self, reads: Sequence[ReadScores], dtype=None,
-                 len_bucket: int = 64, mesh=None, backend: str = "auto"):
+                 len_bucket: int = 64, mesh=None, backend: str = "auto",
+                 band_dtype: str = "f32", band_growth: str = "double"):
         """`mesh`: an optional jax.sharding.Mesh with a "reads" axis. When
         given, the read axis of every batch array is sharded across the
         mesh, per-read DP fills run on their home devices, and the
         proposal-score reduction over reads happens on device — XLA
         inserts the psum over ICI. One consensus then spans all chips
         (the BASELINE north star; replaces scripts/rifraf.jl:190-191's
-        process parallelism with collectives)."""
+        process parallelism with collectives).
+
+        `band_dtype`/`band_growth`: the byte-wall levers (params.
+        RifrafParams): HBM store dtype of the DP band tables and the
+        bandwidth-adaptation policy (engine.bandgrowth)."""
         self.dtype = resolve_dtype(dtype)
         self.len_bucket = int(len_bucket)
         self.mesh = mesh
         self.backend = backend
+        if band_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"band_dtype must be 'f32' or 'bf16', got {band_dtype!r}"
+            )
+        check_band_growth(band_growth)
+        if mesh is not None:
+            # the shard_map wrappers and their psum epilogues compile
+            # against the f32 band layout with uniform doubling; both
+            # levers are single-device (and sweep-fleet) features, so a
+            # mesh silently rides the exact defaults
+            band_dtype, band_growth = "f32", "double"
+        self.band_dtype = band_dtype
+        self.band_growth = band_growth
         # resolved per aligner, not as a process global: cluster-sweep
         # threads pinned to different (possibly heterogeneous) devices
         # must each chunk against their OWN device's HBM
@@ -284,13 +327,16 @@ class BatchAligner:
     # --- Pallas fast path -------------------------------------------------
     def _pallas_K(self, tlen: int, margin: int = 0) -> int:
         """Uniform-frame band height for the current bandwidths (+margin
-        template-length drift headroom), rounded to the f32 sublane tile."""
+        template-length drift headroom), rounded to the store dtype's
+        sublane tile: 8 for f32, 16 for bf16 (the TPU bf16 min tile is
+        (16, 128) — an 8-row bf16 block would relayout on every store)."""
         bw = self.bandwidths.astype(np.int64)
         lengths = self._lengths_host.astype(np.int64)
         off = np.maximum(tlen - lengths, 0) + bw
         nd = 2 * bw + np.abs(lengths - tlen) + 1
         K = int((off.max() - off + nd).max()) + margin
-        return ((K + 7) // 8) * 8
+        mult = 16 if self.band_dtype == "bf16" else 8
+        return ((K + mult - 1) // mult) * mult
 
     def _pallas_mode(self, tlen: int):
         """Which Pallas path serves this problem: "single" (one fused
@@ -325,8 +371,12 @@ class BatchAligner:
             # single launch holds both streams' bands + the halo-blocked
             # backward copy + dense temporaries (~4 bands); keep 1/3 of
             # the budget as transient headroom — a barely-fitting single
-            # launch OOMs on XLA's scratch copies
-            if 4 * T1p * K_uni * Npad * 4 <= 0.66 * self.hbm_budget:
+            # launch OOMs on XLA's scratch copies. Band bytes scale with
+            # the store dtype: bf16 halves them, widening the single-
+            # launch range (panel mode below stays f32-internal, so its
+            # bytes stay at 4)
+            band_isz = _band_itemsize(self.band_dtype)
+            if 4 * T1p * K_uni * Npad * band_isz <= 0.66 * self.hbm_budget:
                 return "single"
             # long templates: panel mode keeps ONE full band (donated
             # in-place panel writes, no concat copy) + the int8 move
@@ -431,7 +481,9 @@ class BatchAligner:
             T1p, K, want_stats=want_stats, want_moves=want_moves)[0]
         C = _dense_cols(T1p, K, _bucket(self.batch.n_reads, 128),
                         want_stats=want_stats, impl=impl,
-                        n_live=self.batch.n_reads)
+                        n_live=self.batch.n_reads,
+                        band_dtype=self.band_dtype,
+                        bw_hist=_bw_hist(self.bandwidths))
         bufs = self._ensure_fill_bufs()
         batch = self._current_batch()
         self.n_forward_fills += 1
@@ -472,6 +524,7 @@ class BatchAligner:
                     weights, K, T1p, C,
                     want_stats=want_stats, want_moves=want_moves,
                     interpret=_pallas_interpret(), impl=impl,
+                    band_dtype=self.band_dtype,
                 )
             Npad = bufs.seq_T.shape[1]
             slots = np.arange(self.batch.n_reads)
@@ -496,7 +549,9 @@ class BatchAligner:
         T1p = _bucket(T1, 64)
         K = self._pallas_K(tlen)
         Npad = _bucket(self.batch.n_reads, 128)
-        C = _dense_cols(T1p, K, Npad, want_stats=want_stats)
+        # panels stay f32-internal (band_dtype not threaded): default isz
+        C = _dense_cols(T1p, K, Npad, want_stats=want_stats,
+                        bw_hist=_bw_hist(self.bandwidths))
         # panel size: per-panel temporaries (~2.2 band-panels) stay a
         # small fraction of the budget; multiple of C
         per_col = 13 * K * Npad * 4
@@ -647,7 +702,7 @@ class BatchAligner:
             and T1 <= DENSE_BLOCK_THRESHOLD
         )
         key = (Tmax, K, use_pallas, do_indels, min_dist, history_cap,
-               stop_on_same, use_edits, impl, seg_pair)
+               stop_on_same, use_edits, impl, seg_pair, self.band_dtype)
         if key in self._stage_runners:
             return self._stage_runners[key]
         bw_dev = jnp.asarray(self.bandwidths)
@@ -656,11 +711,13 @@ class BatchAligner:
         if use_pallas:
             C = _dense_cols(T1p, K, _bucket(n_reads, 128),
                             want_stats=use_edits, impl=impl,
-                            n_live=n_reads)
+                            n_live=n_reads, band_dtype=self.band_dtype,
+                            bw_hist=_bw_hist(self.bandwidths))
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_stage_runner(
                 K, T1p, C, do_indels, min_dist,
                 history_cap, Tmax, stop_on_same, use_edits, impl,
+                self.band_dtype,
             )
             state = (self._ensure_fill_bufs(), lengths_dev, bw_dev, weights)
         else:
@@ -670,13 +727,16 @@ class BatchAligner:
             base = _xla_stage_runner(
                 K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
                 history_cap, stop_on_same, use_edits, seg_pair,
+                self.band_dtype,
             )
             # one roofline record per compiled shape (like the Pallas
             # branch): lane occupancy against the 128-lane vector axis,
             # with segment-pair packing the re-score rides 2x the lanes
             n_live = 2 * n_reads if seg_pair else n_reads
             _dense_cols(_bucket(T1, 64), K, Npad=_bucket(n_live, 128),
-                        want_stats=use_edits, impl="xla", n_live=n_live)
+                        want_stats=use_edits, impl="xla", n_live=n_live,
+                        band_dtype=self.band_dtype,
+                        bw_hist=_bw_hist(self.bandwidths))
             state = (
                 (batch.seq, batch.match, batch.mismatch, batch.ins,
                  batch.dels),
@@ -750,7 +810,7 @@ class BatchAligner:
             impl = select_impl(T1p, K)[0]
         key = ("frame", Tmax, K, use_pallas, do_subs, min_dist,
                history_cap, stop_on_same, Kc, T1pc, nrows, ref.bandwidth,
-               seed_gate, impl)
+               seed_gate, impl, self.band_dtype)
         hit = self._stage_runners.get(key)
         if hit is not None and hit[0] is rt:
             return hit[1]
@@ -764,12 +824,13 @@ class BatchAligner:
 
         if use_pallas:
             C = _dense_cols(T1p, K, _bucket(n_reads, 128), impl=impl,
-                            n_live=n_reads)
+                            n_live=n_reads, band_dtype=self.band_dtype,
+                            bw_hist=_bw_hist(self.bandwidths))
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_frame_runner(
                 K, T1p, C, True, do_subs, min_dist, history_cap, Tmax,
                 stop_on_same, Kc, T1pc, nrows, rt.do_cins, rt.do_cdel,
-                seed_gate, impl,
+                seed_gate, impl, self.band_dtype,
             )
             read_state = (self._ensure_fill_bufs(), lengths_dev, bw_dev,
                           weights)
@@ -780,7 +841,7 @@ class BatchAligner:
             base = _xla_frame_runner(
                 K, T1, Tmax, chunk, n_reads, True, do_subs, min_dist,
                 history_cap, stop_on_same, Kc, T1pc, nrows,
-                rt.do_cins, rt.do_cdel, seed_gate,
+                rt.do_cins, rt.do_cdel, seed_gate, self.band_dtype,
             )
             read_state = (
                 (batch.seq, batch.match, batch.mismatch, batch.ins,
@@ -891,6 +952,7 @@ class BatchAligner:
                     want_moves,
                     want_stats,
                     chunk,
+                    band_dtype=self.band_dtype,
                 )
             self.A_bands, self.B_bands = A, B
             self.moves, self.geom = moves, geom
@@ -940,9 +1002,22 @@ class BatchAligner:
         # already-doubled value each round would let a read grow past
         # the final refill, leaving A and B with mismatched band heights
         entry_bw = self.bandwidths.copy()
+        want_edge = self.band_growth == "adaptive"
+        if want_edge:
+            # adaptive mode enters at min(bandwidth, 16): most reads
+            # never needed the caller's default band, and the policy
+            # grows the few that ride the wall. The cap above still
+            # derives from the ORIGINAL entry bandwidths.
+            lowered = np.where(self.fixed, self.bandwidths,
+                               adaptive_entry(self.bandwidths))
+            if not np.array_equal(lowered, self.bandwidths):
+                self.bandwidths = lowered.astype(self.bandwidths.dtype)
+                self._bw_dev = None
         for _round in range(MAX_BANDWIDTH_DOUBLINGS + 1):
+            edge_hits = None
             if self._adapt_pallas_ok(tlen):
-                n_errors = self._adapt_round_pallas(t_dev, tlen)
+                n_errors, edge_hits = self._adapt_round_pallas(
+                    t_dev, tlen, want_edge)
             else:
                 batch = self._current_batch()
                 K = self._K(tlen)
@@ -957,34 +1032,45 @@ class BatchAligner:
                     _, _, _, packed = fused_step_full(
                         t_dev, batch.seq, batch.match, batch.mismatch,
                         batch.ins, batch.dels, geom, weights, K,
-                        False, True, chunk, False,
+                        False, True, chunk, False, want_edge,
+                        self.band_dtype,
                     )
                 with self.timers.time("adapt_fetch"):
                     ph = np.asarray(packed)
-                lay = pack_layout(self.batch.n_reads, T1, True, False)
+                lay = pack_layout(self.batch.n_reads, T1, True, False,
+                                  want_edge)
                 n_errors = ph[slice(*lay["n_errors"])].astype(np.int64)
+                if want_edge:
+                    edge_hits = ph[slice(*lay["edge_hits"])].astype(
+                        np.int64)
             if (n_errors[: len(self.reads)] < 0).any():
                 raise RuntimeError(
                     "device traceback hit TRACE_NONE (malformed band)"
                 )
             grew = self._maybe_grow_bandwidth(n_errors, tlen, pvalue,
-                                              entry_bw)
+                                              entry_bw, edge_hits)
             if not grew:
                 self.fixed[:] = True
                 break
 
-    def _adapt_round_pallas(self, t_dev, tlen: int) -> np.ndarray:
+    def _adapt_round_pallas(self, t_dev, tlen: int,
+                            want_edge: bool = False):
         """One adaptation round on the Pallas engine: forward-only fill
         with in-kernel move recording + device traceback statistics —
         no backward stream, no dense sweep (ops.dense_pallas.
-        fill_stats_pallas). Returns per-read alignment error counts."""
+        fill_stats_pallas). Returns (n_errors, edge_hits-or-None):
+        per-read alignment error counts plus, under the adaptive
+        growth policy, the count of on-path cells pinned to a band-limit
+        row (the stats kernels' ``want_edge`` section)."""
         import jax.numpy as jnp
 
         from ..ops.dense_pallas import fill_stats_pallas
 
         T1p = _bucket(int(t_dev.shape[0]) + 1, 64)
         K = self._pallas_K(tlen)
-        C = _fill_cols(T1p, K, _bucket(self.batch.n_reads, 128))
+        C = _fill_cols(T1p, K, _bucket(self.batch.n_reads, 128),
+                       band_dtype=self.band_dtype,
+                       bw_hist=_bw_hist(self.bandwidths))
         bufs = self._ensure_fill_bufs()
         batch = self._current_batch()
         self.n_forward_fills += 1
@@ -1004,36 +1090,45 @@ class BatchAligner:
             with self.timers.time("adapt_dispatch"):
                 packed = fill_stats_pallas(
                     t_dev, jnp.int32(tlen), bufs, geom, K, T1p, C,
-                    interpret=_pallas_interpret(),
+                    interpret=_pallas_interpret(), want_edge=want_edge,
+                    band_dtype=self.band_dtype,
                 )
             Npad = bufs.seq_T.shape[1]
             slots = np.arange(self.batch.n_reads)
         with self.timers.time("adapt_fetch"):
             ph = np.asarray(packed)
-        return ph[Npad:][slots].astype(np.int64)
+        n_errors = ph[Npad : 2 * Npad][slots].astype(np.int64)
+        if want_edge:
+            return n_errors, ph[2 * Npad :][slots].astype(np.int64)
+        return n_errors, None
 
     def _maybe_grow_bandwidth(self, n_errors, tlen: int, pvalue: float,
-                              entry_bw: np.ndarray) -> bool:
-        """Double bandwidths of reads whose alignments look band-limited
-        (model.jl:655-671). Returns True if any bandwidth grew."""
-        grew = False
-        for k in range(len(self.reads)):
-            if self.fixed[k]:
-                continue
-            slen = int(self._lengths_host[k])
-            max_bw = min(int(entry_bw[k]) << MAX_BANDWIDTH_DOUBLINGS, tlen, slen)
-            threshold = poisson_cquantile(self.est_n_errors[k], pvalue)
-            if (
-                n_errors[k] > threshold
-                and n_errors[k] < self._old_errors[k]
-                and self.bandwidths[k] < max_bw
-            ):
-                self.bandwidths[k] = min(int(self.bandwidths[k]) * 2, max_bw)
-                self._old_errors[k] = n_errors[k]
-                self._bw_dev = None  # invalidate the sharded device copy
-                grew = True
-            else:
-                self.fixed[k] = True
+                              entry_bw: np.ndarray,
+                              edge_hits=None) -> bool:
+        """Grow bandwidths of reads whose alignments look band-limited
+        (model.jl:655-671), by the policy in engine.bandgrowth: blunt
+        x2 doubling (default, the reference port) or per-read adaptive
+        growth from the traceback's band-edge hit counts. Returns True
+        if any bandwidth grew."""
+        n = len(self.reads)
+        thresholds = np.array([
+            poisson_cquantile(self.est_n_errors[k], pvalue)
+            for k in range(n)
+        ])
+        new_bw, new_fixed, new_old = grow_bandwidths(
+            self.bandwidths[:n], self.fixed[:n], self._old_errors[:n],
+            np.asarray(n_errors)[:n], thresholds, entry_bw[:n], tlen,
+            self._lengths_host[:n].astype(np.int64),
+            band_growth=self.band_growth,
+            edge_hits=(None if edge_hits is None
+                       else np.asarray(edge_hits)[:n]),
+        )
+        grew = bool((new_bw != self.bandwidths[:n]).any())
+        self.bandwidths[:n] = new_bw
+        self.fixed[:n] = new_fixed
+        self._old_errors[:n] = new_old
+        if grew:
+            self._bw_dev = None  # invalidate the sharded device copy
         return grew
 
     def total_score(self, weights: Optional[np.ndarray] = None) -> float:
@@ -1256,7 +1351,8 @@ def _frame_seed_gates(tmpl, tlen, rt9s, Kc: int, T1pc: int, nrows: int,
 @functools.lru_cache(maxsize=32)
 def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
                          history_cap, Tmax, stop_on_same, Kc, T1pc, nrows,
-                         do_cins, do_cdel, seed_gate=False, impl="split"):
+                         do_cins, do_cdel, seed_gate=False, impl="split",
+                         band_dtype="f32"):
     """Compiled device FRAME stage loop: Pallas read step + codon-engine
     reference tables. step_state = ((FillBuffers, lengths, bandwidths,
     weights), rt_arrays[, skewed rt_arrays]). ``impl`` is the fused-step
@@ -1278,6 +1374,7 @@ def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
         out = fused_tables_auto(
             tmpl, tlen, bufs, geom, weights, K, T1p, C,
             interpret=_pallas_interpret(), impl=impl,
+            band_dtype=band_dtype,
         )
         base = _add_ref_tables(
             (out["total"], out["sub"], out["ins"], out["del"]),
@@ -1302,7 +1399,7 @@ def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
 @functools.lru_cache(maxsize=32)
 def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
                       min_dist, history_cap, stop_on_same, Kc, T1pc, nrows,
-                      do_cins, do_cdel, seed_gate=False):
+                      do_cins, do_cdel, seed_gate=False, band_dtype="f32"):
     """Compiled device FRAME stage loop over the fused XLA scan step
     (CPU equality tests / f64 runs). step_state = (((seq, match,
     mismatch, ins, dels), lengths, bandwidths, weights), rt_arrays[,
@@ -1323,7 +1420,7 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
         geom = BandGeometry.make(lengths, tlen, bw)
         _, _, _, packed = fused_step_full(
             tmpl[:Tmax], seq, match, mismatch, ins, dels, geom, weights,
-            K, False, False, chunk,
+            K, False, False, chunk, band_dtype=band_dtype,
         )
         base = _add_ref_tables(
             unpack_tables(packed, n_reads, T1),
@@ -1344,7 +1441,7 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
 @functools.lru_cache(maxsize=64)
 def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
                          history_cap, Tmax, stop_on_same, use_edits=False,
-                         impl="split"):
+                         impl="split", band_dtype="f32"):
     """Compiled device stage loop over the Pallas fused step, shared
     across aligners of identical shape config. step_state =
     (FillBuffers, lengths, bandwidths, weights). ``impl`` routes each
@@ -1361,7 +1458,7 @@ def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
         out = fused_tables_auto(
             tmpl, tlen, bufs, geom, weights, K, T1p, C,
             want_stats=use_edits, interpret=_pallas_interpret(),
-            impl=impl,
+            impl=impl, band_dtype=band_dtype,
         )
         base = (out["total"], out["sub"], out["ins"], out["del"])
         if use_edits:
@@ -1381,7 +1478,7 @@ def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
 @functools.lru_cache(maxsize=64)
 def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
                       history_cap, stop_on_same, use_edits=False,
-                      seg_pair=False):
+                      seg_pair=False, band_dtype="f32"):
     """Compiled device stage loop over the fused XLA scan step (any
     backend / f64 exactness runs). step_state = ((seq, match, mismatch,
     ins, dels), lengths, bandwidths, weights).
@@ -1406,7 +1503,7 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
         geom = BandGeometry.make(lengths, tlen, bw)
         _, _, _, packed = fused_step_full(
             tmpl[:Tmax], seq, match, mismatch, ins, dels, geom, weights,
-            K, False, use_edits, chunk,
+            K, False, use_edits, chunk, band_dtype=band_dtype,
         )
         return unpack_tables(packed, n_reads, T1, use_edits)
 
@@ -1428,6 +1525,7 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
                 two(mismatch), two(ins), two(dels), two(lengths),
                 two(bw), two(weights), K, 2,
                 want_stats=use_edits, want_tables=True,
+                band_dtype=band_dtype,
             )
             tables = (out["total"], out["sub"], out["ins"], out["del"])
             if use_edits:

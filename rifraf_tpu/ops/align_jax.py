@@ -505,12 +505,23 @@ def _resolve_insert_chain(seed, ichain):
     return (F > -1e5)[::-1]
 
 
-def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
+def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int,
+                         edge_lo=None, edge_hi=None,
+                         want_edge: bool = False):
     """Device traceback statistics for one read: (a) the alignment error
     count of the optimal path (count_errors, align.jl:240-250) and (b) an
     indicator table of the single-base edits the path implies
     (moves_to_proposals, model.jl:458-480): columns 0-3 substitution
     bases, 4-7 insertion bases, 8 deletion; rows = template positions.
+
+    ``want_edge`` appends (c) the count of on-path cells sitting exactly
+    on the band-limit rows — the score-frontier signal adaptive band
+    growth keys on (a path forced along the band wall means the optimum
+    likely lies outside it). ``edge_lo``/``edge_hi`` give the limit rows
+    in this move band's frame; they default to 0 and ``geom.nd - 1``
+    (the per-read XLA frame), and uniform-frame callers MUST pass the
+    read's true limits (the shared frame widens ``nd``, so the frame
+    edge is not the band edge).
 
     The move band assigns every cell exactly one predecessor, so the
     traceback path equals the predecessor-closure of the end cell — which
@@ -527,6 +538,9 @@ def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
     d = jnp.arange(K, dtype=jnp.int32)
     off = geom.offset
     d_end = jnp.maximum(geom.slen - geom.tlen, 0) + geom.bandwidth
+    e_lo = jnp.int32(0) if edge_lo is None else jnp.asarray(edge_lo, jnp.int32)
+    e_hi = (geom.nd - 1 if edge_hi is None
+            else jnp.asarray(edge_hi, jnp.int32))
     # padded read bases + per-column template bases: the scan body reads
     # its [K]-windows with contiguous slices, no gathers (see _forward_one)
     sqp = jnp.pad(seq, (K, K + T1))
@@ -548,12 +562,15 @@ def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
         sub_any = jnp.stack([jnp.any(mism & (sb == b)) for b in range(4)])
         ins_any = jnp.stack([jnp.any(is_i & (sb == b)) for b in range(4)])
         del_any = jnp.any(is_d)
+        hits_c = jnp.sum(
+            (on & ((d == e_lo) | (d == e_hi))).astype(jnp.int32)
+        )
         # a complete path reaches cell (0, 0) = data row `offset` of col 0
         reached0 = jnp.any(on & (d == off) & (jc == 0))
         # seeds for column jc-1: match pred at the same data row, delete
         # pred one data row down
         Pnext = is_m | jnp.concatenate([jnp.zeros((1,), bool), is_d[:-1]])
-        return Pnext, (nerr_c, sub_any, ins_any, del_any, reached0)
+        return Pnext, (nerr_c, sub_any, ins_any, del_any, reached0, hits_c)
 
     # unroll C columns per scan step (see _forward_one: per-step [K]
     # work cannot amortize the TPU scan-step overhead). The scan covers
@@ -586,11 +603,11 @@ def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
         tb_cols[:0:-1].reshape((T1 - 1) // C, C),
     )
     P0 = jnp.zeros((K,), bool)
-    Pend, (nerr_c, sub_any, ins_any, del_any, reached0) = jax.lax.scan(
-        block, P0, xs
+    Pend, (nerr_c, sub_any, ins_any, del_any, reached0, hits_c) = (
+        jax.lax.scan(block, P0, xs)
     )
     sb_col0 = jax.lax.dynamic_slice(sqp, (jnp.asarray(K - off - 1, jnp.int32),), (K,))
-    _, (nerr0, sub0, ins0, del0, reached0_0) = step(
+    _, (nerr0, sub0, ins0, del0, reached0_0, hits0) = step(
         Pend, (jnp.int32(0), moves[:, 0], sb_col0, tb_cols[0])
     )
     flat = lambda x: x.reshape((T1 - 1,) + x.shape[2:])
@@ -599,6 +616,7 @@ def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
     ins_any = jnp.concatenate([flat(ins_any), ins0[None]])
     del_any = jnp.concatenate([flat(del_any), del0[None]])
     reached0 = jnp.concatenate([flat(reached0), reached0_0[None]])
+    hits_c = jnp.concatenate([flat(hits_c), hits0[None]])
     # scan ran j descending; flip to ascending-j order
     sub_any, ins_any, del_any = sub_any[::-1], ins_any[::-1], del_any[::-1]
     nerr = jnp.sum(nerr_c)
@@ -611,6 +629,8 @@ def _traceback_stats_one(moves, seq, t, geom: BandGeometry, K: int):
     edits = jnp.concatenate(
         [sub_t, ins_any, del_t[:, None]], axis=1
     ).astype(jnp.int8)
+    if want_edge:
+        return nerr, edits, jnp.sum(hits_c)
     return nerr, edits
 
 

@@ -171,9 +171,11 @@ def _dense_kernel(
 
     for c in range(C):
         j = col0 + jb * C + c
-        A_j = a_ref[0, c * K : (c + 1) * K, :]
-        B_j = bh_ref[0, c * K : (c + 1) * K, :]
-        B_n = bh_ref[0, (c + 1) * K : (c + 2) * K, :]
+        # load-wide: the band store may be narrower (bf16); every max-plus
+        # candidate and join below accumulates in f32. No-op for f32 bands.
+        A_j = a_ref[0, c * K : (c + 1) * K, :].astype(jnp.float32)
+        B_j = bh_ref[0, c * K : (c + 1) * K, :].astype(jnp.float32)
+        B_n = bh_ref[0, (c + 1) * K : (c + 2) * K, :].astype(jnp.float32)
 
         # A[d+1, j], A[d-1, j], B[d-1, j+1]
         A_up = pltpu.roll(A_j, K - 1, axis=0)
@@ -343,7 +345,8 @@ def _moves_band(moves_flat, K: int, T1p: int, Npad: int):
 
 
 def stats_from_moves(moves, seq_lanes, template, geom: BandGeometry,
-                     lengths, K: int, off_override=None):
+                     lengths, K: int, off_override=None,
+                     want_edge: bool = False):
     """Device traceback statistics over the Pallas move band: per-lane
     alignment error counts and the union single-base-edit indicator table
     (the Pallas counterpart of ops.fused's want_stats components).
@@ -354,12 +357,31 @@ def stats_from_moves(moves, seq_lanes, template, geom: BandGeometry,
     align_jax._traceback_stats_one, which works unchanged because
     uniform_geometry re-expresses the uniform frame in its per-read
     terms. Padding lanes have all-NONE moves (their n_errors slot is -1;
-    callers slice to real reads) and contribute nothing to the union."""
+    callers slice to real reads) and contribute nothing to the union.
+    ``want_edge`` appends per-lane band-edge-hit counts (the on-path
+    cells sitting exactly on a band limit — the adaptive-growth
+    frontier signal); the 2-tuple return is unchanged without it."""
     from .align_jax import _traceback_stats_one
     from .fill_pallas import uniform_geometry
 
     ugeom = uniform_geometry(geom, lengths=lengths,
                              off_override=off_override)
+    if want_edge:
+        # the uniform frame widens every lane's nd to the shared K, so
+        # the read's TRUE band limits must ride along explicitly:
+        # uniform row d maps to per-read row d - delta_k, whose edges
+        # sit at d == delta_k and d == delta_k + nd_k - 1
+        Npad = moves.shape[0]
+        OFF = jnp.max(geom.offset) if off_override is None else (
+            jnp.asarray(off_override, jnp.int32)
+        )
+        delta = _pad_lanes((OFF - geom.offset).astype(jnp.int32), Npad)
+        ndv = _pad_lanes(geom.nd.astype(jnp.int32), Npad)
+        nerr, edits, ehits = jax.vmap(
+            functools.partial(_traceback_stats_one, want_edge=True),
+            in_axes=(0, 0, None, 0, None, 0, 0),
+        )(moves, seq_lanes, template, ugeom, K, delta, delta + ndv - 1)
+        return nerr, jnp.max(edits, axis=0), ehits
     nerr, edits = jax.vmap(
         _traceback_stats_one, in_axes=(0, 0, None, 0, None)
     )(moves, seq_lanes, template, ugeom, K)
@@ -380,6 +402,7 @@ def fused_tables_pallas(
     off_override=None,
     slen_min=None,
     interpret: bool = False,
+    band_dtype: str = "f32",
 ):
     """One hill-climb iteration's device work, all-Pallas: forward +
     backward fills (one launch), backward alignment, dense all-edits
@@ -387,7 +410,9 @@ def fused_tables_pallas(
     move band — the Pallas counterpart of ops.fused.fused_step_full.
     Returns a dict with total, scores [Npad], sub [T1p, 4], ins [T1p, 4],
     del [T1p], plus n_errors [Npad] / edits [T1, 9] (want_stats) and the
-    forward move band [Npad, K, T1p] int8 (want_moves)."""
+    forward move band [Npad, K, T1p] int8 (want_moves). ``band_dtype``
+    ("f32"/"bf16") selects the HBM store dtype of both band buffers;
+    scores, tables, and every reduction stay f32 either way."""
     from . import fill_pallas
 
     Npad = bufs.seq_T.shape[1]
@@ -400,7 +425,7 @@ def fused_tables_pallas(
     band_flat, scores2, moves_flat = fill_pallas._fill_call(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
         K=K, T1p=T1p, NBLK=2 * NB, C=C, want_moves=need_moves,
-        interpret=interpret,
+        interpret=interpret, band_dtype=band_dtype,
     )
     scores = scores2[0, :Npad]
 
@@ -464,19 +489,20 @@ def fused_tables_pallas(
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "C", "want_stats", "want_moves",
-                     "interpret"),
+                     "interpret", "band_dtype"),
 )
 def fused_step_pallas(
     template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
     K: int, T1p: int, C: int,
     want_stats: bool = False, want_moves: bool = False,
-    interpret: bool = False,
+    interpret: bool = False, band_dtype: str = "f32",
 ):
     """Packed-single-fetch wrapper of fused_tables_pallas (layout:
     pack_layout_pallas). Returns (packed, moves-or-None)."""
     out = fused_tables_pallas(
         template, tlen, bufs, geom, weights, K, T1p, C,
         want_stats=want_stats, want_moves=want_moves, interpret=interpret,
+        band_dtype=band_dtype,
     )
     return jnp.concatenate(pack_parts(out, want_stats)), out.get("moves")
 
@@ -519,19 +545,24 @@ def pack_layout_pallas(Npad: int, T1p: int, want_stats: bool = False,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("K", "T1p", "C", "interpret")
+    jax.jit, static_argnames=("K", "T1p", "C", "interpret", "want_edge",
+                              "band_dtype")
 )
 def fill_stats_pallas(
     template, tlen, bufs: FillBuffers, geom: BandGeometry,
     K: int, T1p: int, C: int, off_override=None,
-    interpret: bool = False,
+    interpret: bool = False, want_edge: bool = False,
+    band_dtype: str = "f32",
 ):
     """Bandwidth-adaptation round on the Pallas engine: forward-only fill
     with in-kernel move recording, then the device traceback statistics —
     no backward stream, no dense sweep (their outputs would be discarded
     every round the bandwidths grow; the XLA path skips them via
     want_tables=False for the same reason). Returns packed
-    [scores (Npad), n_errors (Npad)]."""
+    [scores (Npad), n_errors (Npad)], plus a trailing edge-hit block
+    [edge_hits (Npad)] when ``want_edge`` (on-path traceback cells that
+    sit exactly on the read's band-limit rows — the adaptive-growth
+    frontier signal)."""
     from . import fill_pallas
 
     Npad = bufs.seq_T.shape[1]
@@ -543,23 +574,39 @@ def fill_stats_pallas(
     _, scores2, moves_flat = fill_pallas._fill_call(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
         K=K, T1p=T1p, NBLK=NB, C=C, want_moves=True, interpret=interpret,
+        band_dtype=band_dtype,
     )
     T1 = template.shape[0] + 1
+    ehits = None
     if stats_pallas.use_pallas_stats():
         # adaptation only needs n_errors: skip the indicator tiles
-        nerr, _ = stats_pallas.traceback_stats_pallas(
-            p, moves_flat, K, T1p, C, Npad, T1, want_edits=False,
-            interpret=interpret,
-        )
+        if want_edge:
+            nerr, _, ehits = stats_pallas.traceback_stats_pallas(
+                p, moves_flat, K, T1p, C, Npad, T1, want_edits=False,
+                interpret=interpret, want_edge=True,
+            )
+        else:
+            nerr, _ = stats_pallas.traceback_stats_pallas(
+                p, moves_flat, K, T1p, C, Npad, T1, want_edits=False,
+                interpret=interpret,
+            )
     else:
         moves = _moves_band(moves_flat, K, T1p, Npad)
-        nerr, _ = stats_from_moves(
-            moves[:, :, :T1], bufs.seq_T.T, template, geom, bufs.lengths,
-            K, off_override=off_override,
-        )
-    return jnp.concatenate(
-        [scores2[0, :Npad], nerr.astype(jnp.float32)]
-    )
+        if want_edge:
+            nerr, _, ehits = stats_from_moves(
+                moves[:, :, :T1], bufs.seq_T.T, template, geom,
+                bufs.lengths, K, off_override=off_override,
+                want_edge=True,
+            )
+        else:
+            nerr, _ = stats_from_moves(
+                moves[:, :, :T1], bufs.seq_T.T, template, geom,
+                bufs.lengths, K, off_override=off_override,
+            )
+    parts = [scores2[0, :Npad], nerr.astype(jnp.float32)]
+    if want_edge:
+        parts.append(ehits.astype(jnp.float32))
+    return jnp.concatenate(parts)
 
 
 # --- panel-blocked long-template path --------------------------------------

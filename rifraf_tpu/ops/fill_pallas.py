@@ -96,6 +96,38 @@ NEG_LIVE = NEG_INF / 2
 LANES = 128
 
 
+def neg_inf_for(dtype) -> float:
+    """Finite -inf sentinel that is SUM-SAFE in the given band-store
+    dtype: half the dtype's most-negative finite value, so adding two
+    sentinels (the first accumulation a consumer might do in the narrow
+    dtype) lands exactly on the dtype's finite minimum instead of
+    silently overflowing to -inf. float32 returns the historical
+    NEG_INF constant bit-for-bit (f32.min / 2), keeping the default
+    path's values unchanged; bfloat16 — whose exponent range matches
+    f32 but whose finite max (~3.39e38) sits BELOW 2 * |NEG_INF| —
+    gets bf16.min / 2 (~-1.69e38), which still sits far below the
+    NEG_LIVE liveness threshold so move masking is unaffected."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.float32):
+        return NEG_INF
+    return float(jnp.finfo(dt).min) / 2
+
+
+def band_store_dtype(band_dtype: str):
+    """Map a Params.band_dtype string to the jnp dtype of the band
+    tables' HBM store ("f32" -> float32, "bf16" -> bfloat16). All
+    accumulation stays float32 regardless (cast at load, accumulate
+    wide); this dtype governs only what is written to / read from the
+    band buffers."""
+    if band_dtype == "bf16":
+        return jnp.bfloat16
+    if band_dtype == "f32":
+        return jnp.float32
+    raise ValueError(
+        f"band_dtype must be 'f32' or 'bf16', got {band_dtype!r}"
+    )
+
+
 def uniform_frame(geom: BandGeometry):
     """(OFF, delta, nd) of the shared band frame (dynamic scalars)."""
     OFF = jnp.max(geom.offset)
@@ -175,6 +207,7 @@ def _fill_kernel(
     blocks_per_tpl: int,
     want_moves: bool = False,
     has_carry: bool = False,
+    band_neg: float = NEG_INF,
 ):
     refs = list(refs)
     carry_in = score_in = None
@@ -196,7 +229,10 @@ def _fill_kernel(
     delta = delta_ref[0, 0, :]
     nd = ndv_ref[0, 0, :]
     d = jax.lax.broadcasted_iota(jnp.int32, (K, LANES), 0)
-    neg = jnp.full((K, LANES), NEG_INF, jnp.float32)
+    # band_neg == NEG_INF on the f32 path (bit-identical); a narrower
+    # band store uses its own sum-safe sentinel (neg_inf_for) so the
+    # stored value survives the downcast without overflowing to -inf
+    neg = jnp.full((K, LANES), band_neg, jnp.float32)
     in_lane_band = (d >= delta[None, :]) & (d < (delta + nd)[None, :])
 
     @pl.when(jb == 0)
@@ -276,7 +312,9 @@ def _fill_kernel(
                 mv_ref[c * K : (c + 1) * K, :] = mv.astype(jnp.int32)
 
         prev = F
-        out_ref[c * K : (c + 1) * K, :] = F
+        # store-narrow: a bf16 out_ref takes the cast here; the f32 DP
+        # carry (prev) and the score accumulator never narrow
+        out_ref[c * K : (c + 1) * K, :] = F.astype(out_ref.dtype)
 
         @pl.when(j == tlen)
         def _():
@@ -295,7 +333,8 @@ def _fill_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("K", "T1p", "NBLK", "C", "want_moves", "interpret"),
+    static_argnames=("K", "T1p", "NBLK", "C", "want_moves", "interpret",
+                     "band_dtype"),
 )
 def _fill_call(
     tlen_s,  # [1, 1] int32
@@ -312,12 +351,14 @@ def _fill_call(
     col0=None,  # [1, 1] int32 global first column (panel launches)
     carry_in=None,  # [K, NBLK*128] previous panel's final column
     score_in=None,  # [1, NBLK*128] previous panel's score accumulator
+    band_dtype: str = "f32",
 ):
     n_steps = T1p // C
     CB = mt.shape[1]
     n_tpl = t_cols.shape[0]
     blocks_per_tpl = NBLK // n_tpl
     has_carry = carry_in is not None
+    band_dt = band_store_dtype(band_dtype)
     if col0 is None:
         col0 = jnp.zeros((1, 1), jnp.int32)
 
@@ -338,6 +379,7 @@ def _fill_call(
     kernel = functools.partial(
         _fill_kernel, K=K, C=C, blocks_per_tpl=blocks_per_tpl,
         want_moves=want_moves, has_carry=has_carry,
+        band_neg=neg_inf_for(band_dt),
     )
 
     out_specs = [
@@ -350,7 +392,7 @@ def _fill_call(
         ),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((n_steps * C * K, NBLK * LANES), jnp.float32),
+        jax.ShapeDtypeStruct((n_steps * C * K, NBLK * LANES), band_dt),
         jax.ShapeDtypeStruct((1, NBLK * LANES), jnp.float32),
     ]
     if want_moves:
@@ -702,7 +744,7 @@ def prepare_fill_panels(
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "C", "with_backward", "want_moves",
-                     "interpret"),
+                     "interpret", "band_dtype"),
 )
 def fill_uniform(
     template,  # int8 [Tmax] padded template
@@ -715,6 +757,7 @@ def fill_uniform(
     with_backward: bool = True,
     want_moves: bool = False,
     interpret: bool = False,
+    band_dtype: str = "f32",
 ):
     """Pallas banded fill in the uniform frame.
 
@@ -734,7 +777,7 @@ def fill_uniform(
     band_flat, scores, moves_flat = _fill_call(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
         K=K, T1p=T1p, NBLK=NBLK, C=C, want_moves=want_moves,
-        interpret=interpret,
+        interpret=interpret, band_dtype=band_dtype,
     )
     # [n_steps*C*K, NBLK*128] -> [T1p, K, NBLK*128] -> [lanes, K, T1p]
     band = band_flat.reshape(T1p, K, NBLK * LANES).transpose(2, 1, 0)

@@ -51,9 +51,22 @@ def _fill_barrier_vmap(axis_size, in_batched, ab):
     return jax.lax.optimization_barrier(ab), in_batched[0]
 
 
+def _band_narrow(A, B, band_dtype):
+    """Round the freshly filled band tables to the band-store dtype.
+    ``bf16`` models (and on TPU realizes) a half-width HBM store of the
+    forward/backward bands — exactly the Pallas kernels' bf16 band
+    buffers; every consumer immediately widens back so all downstream
+    accumulation stays in the working dtype. ``f32`` is the identity
+    (bit-identical default)."""
+    if band_dtype == "bf16":
+        return A.astype(jnp.bfloat16), B.astype(jnp.bfloat16)
+    return A, B
+
+
 def _fused_parts(
     template, seq, match, mismatch, ins, dels, geom, weights, K,
-    want_moves, want_stats, want_tables=True,
+    want_moves, want_stats, want_tables=True, want_edge=False,
+    band_dtype="f32",
 ):
     """The per-read-block device work: fills, dense tables, stats.
 
@@ -62,7 +75,9 @@ def _fused_parts(
     ``want_tables=False`` skips the dense all-edits sweep — the
     bandwidth-adaptation rounds only consume scores and traceback
     statistics, and the dense sweep is the single most expensive
-    component of the step (round-4 profile)."""
+    component of the step (round-4 profile). ``want_edge`` adds the
+    per-read band-edge-hit counts (adaptive growth's frontier signal)
+    to the components; requires ``want_stats``."""
     fwd_bwd = jax.vmap(
         align_jax._fwd_bwd_one,
         in_axes=(None, 0, 0, 0, 0, 0, 0, None, None),
@@ -71,7 +86,10 @@ def _fused_parts(
     A, moves, scores, B = fwd_bwd(
         template, seq, match, mismatch, ins, dels, geom, K, need_moves
     )
+    wide = A.dtype
+    A, B = _band_narrow(A, B, band_dtype)
     A, B = _fill_barrier((A, B))
+    A, B = A.astype(wide), B.astype(wide)
 
     T1 = template.shape[0] + 1
     if not want_tables:
@@ -100,10 +118,21 @@ def _fused_parts(
         "del": del_t,
     }
     if want_stats:
-        stats = jax.vmap(
-            align_jax._traceback_stats_one, in_axes=(0, 0, None, 0, None)
-        )
-        nerr, edits = stats(moves, seq, template, geom, K)
+        if want_edge:
+            stats = jax.vmap(
+                functools.partial(
+                    align_jax._traceback_stats_one, want_edge=True
+                ),
+                in_axes=(0, 0, None, 0, None),
+            )
+            nerr, edits, ehits = stats(moves, seq, template, geom, K)
+            comp["edge_hits"] = ehits
+        else:
+            stats = jax.vmap(
+                align_jax._traceback_stats_one,
+                in_axes=(0, 0, None, 0, None),
+            )
+            nerr, edits = stats(moves, seq, template, geom, K)
         comp["n_errors"] = nerr
         # union over reads; a zero-weight padding read duplicates a real
         # read so its contribution is a no-op for the union
@@ -118,6 +147,8 @@ def _pack(comp, dtype, want_stats):
     if want_stats:
         parts.append(comp["n_errors"].astype(dtype))
         parts.append(comp["edits"].reshape(-1).astype(dtype))
+        if "edge_hits" in comp:
+            parts.append(comp["edge_hits"].astype(dtype))
     parts += [
         comp["sub"].reshape(-1),
         comp["ins"].reshape(-1),
@@ -129,11 +160,12 @@ def _pack(comp, dtype, want_stats):
 @functools.partial(
     jax.jit,
     static_argnames=("K", "want_moves", "want_stats", "read_chunk",
-                     "want_tables"),
+                     "want_tables", "want_edge", "band_dtype"),
 )
 def fused_step_full(
     template, seq, match, mismatch, ins, dels, geom, weights, K,
     want_moves=False, want_stats=False, read_chunk=0, want_tables=True,
+    want_edge=False, band_dtype="f32",
 ):
     """One driver iteration's full device work in one dispatch.
 
@@ -165,7 +197,7 @@ def fused_step_full(
     if not read_chunk or seq.shape[0] <= read_chunk:
         A, B, moves, comp = _fused_parts(
             template, seq, match, mismatch, ins, dels, geom, weights, K,
-            want_moves, want_stats, want_tables,
+            want_moves, want_stats, want_tables, want_edge, band_dtype,
         )
         return A, B, moves, _pack(comp, match.dtype, want_stats)
 
@@ -199,7 +231,8 @@ def fused_step_full(
         seq_c, match_c, mismatch_c, ins_c, dels_c, geom_c, w_c = x
         _, _, moves_c, comp = _fused_parts(
             template, seq_c, match_c, mismatch_c, ins_c, dels_c, geom_c,
-            w_c, K, want_moves, want_stats, want_tables,
+            w_c, K, want_moves, want_stats, want_tables, want_edge,
+            band_dtype,
         )
         if moves_c is None:
             moves_c = jnp.zeros((0,), jnp.int8)
@@ -218,6 +251,8 @@ def fused_step_full(
         # padding rows duplicate a real read, so the per-chunk unions
         # already exclude nothing and add nothing
         comp["edits"] = jnp.max(comps["edits"], axis=0)
+        if want_edge:
+            comp["edge_hits"] = comps["edge_hits"].reshape(Np)[:N]
     moves = (
         moves_b.reshape((Np,) + moves_b.shape[2:])[:N] if want_moves else None
     )
@@ -273,12 +308,14 @@ def segment_union_max_lanes(seg_ids, x, n_seg: int):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("K", "n_seg", "want_stats", "want_tables"),
+    static_argnames=("K", "n_seg", "want_stats", "want_tables",
+                     "want_edge", "band_dtype"),
 )
 def fused_step_segmented(
     templates, tlens, seg_ids, seq, match, mismatch, ins, dels,
     lengths, bandwidths, weights, K, n_seg,
-    want_stats=False, want_tables=True,
+    want_stats=False, want_tables=True, want_edge=False,
+    band_dtype="f32",
 ):
     """The fused step for a SEGMENT-PACKED lane block: multiple
     independent problems share one ``[N]`` read block, identified by a
@@ -327,7 +364,10 @@ def fused_step_segmented(
     A, moves, scores, B = fwd_bwd(
         t_lane, seq, match, mismatch, ins, dels, geom, K, want_stats
     )
+    wide = A.dtype
+    A, B = _band_narrow(A, B, band_dtype)
     A, B = _fill_barrier((A, B))
+    A, B = A.astype(wide), B.astype(wide)
 
     seg_w = segment_weights(seg_ids, weights, n_seg)
     out = {
@@ -348,10 +388,20 @@ def fused_step_segmented(
         out["ins"] = jnp.zeros((n_seg, 0, 4), A.dtype)
         out["del"] = jnp.zeros((n_seg, 0), A.dtype)
     if want_stats:
-        stats = jax.vmap(
-            align_jax._traceback_stats_one, in_axes=(0, 0, 0, 0, None)
-        )
-        nerr, edits = stats(moves, seq, t_lane, geom, K)
+        if want_edge:
+            stats = jax.vmap(
+                functools.partial(
+                    align_jax._traceback_stats_one, want_edge=True
+                ),
+                in_axes=(0, 0, 0, 0, None),
+            )
+            nerr, edits, ehits = stats(moves, seq, t_lane, geom, K)
+            out["edge_hits"] = ehits
+        else:
+            stats = jax.vmap(
+                align_jax._traceback_stats_one, in_axes=(0, 0, 0, 0, None)
+            )
+            nerr, edits = stats(moves, seq, t_lane, geom, K)
         out["n_errors"] = nerr
         mask = seg_ids[None, :] == jnp.arange(n_seg)[:, None]
         out["edits"] = jax.vmap(
@@ -364,8 +414,11 @@ def fused_step_segmented(
 
 
 def pack_layout(n_reads: int, T1: int, want_stats: bool,
-                want_tables: bool = True):
-    """Slice map of fused_step_full's packed array: name -> (start, stop)."""
+                want_tables: bool = True, want_edge: bool = False):
+    """Slice map of fused_step_full's packed array: name -> (start, stop).
+    ``want_edge`` (valid only with ``want_stats``) inserts the per-read
+    ``edge_hits`` section after ``edits`` — absent by default, so every
+    existing layout stays byte-identical."""
     out = {}
     o = 0
 
@@ -379,6 +432,8 @@ def pack_layout(n_reads: int, T1: int, want_stats: bool,
     if want_stats:
         take("n_errors", n_reads)
         take("edits", T1 * 9)
+        if want_edge:
+            take("edge_hits", n_reads)
     if want_tables:
         take("sub", T1 * 4)
         take("ins", T1 * 4)

@@ -95,6 +95,8 @@ from .fill_pallas import (
     _block_tables,
     _cumop,
     _pad_lanes,
+    band_store_dtype,
+    neg_inf_for,
 )
 from .align_jax import BandGeometry
 from .dense_pallas import ROWS, fused_tables_pallas, pack_parts
@@ -311,6 +313,7 @@ def _mega_kernel(
     C: int,
     n_steps: int,
     want_stats: bool,
+    band_neg: float = NEG_INF,
 ):
     refs = list(refs)
     dense_ref = refs.pop(0)
@@ -341,7 +344,10 @@ def _mega_kernel(
     nd = ndv_ref[0, 0, :]
     dend = dend_ref[0, 0, :]
     d = jax.lax.broadcasted_iota(jnp.int32, (K, LANES), 0)
-    neg = jnp.full((K, LANES), NEG_INF, jnp.float32)
+    # band_neg == NEG_INF on the f32 path (bit-identical); a bf16 band
+    # store swaps in its own sum-safe finite sentinel (neg_inf_for) so
+    # stored sentinels round-trip the narrow band exactly
+    neg = jnp.full((K, LANES), band_neg, jnp.float32)
 
     @pl.when(jb == 0)
     def _():
@@ -405,7 +411,9 @@ def _mega_kernel(
                 stage_mv[c * K : (c + 1) * K, :] = mv.astype(jnp.int32)
 
             prev_f = F
-            stage_f[c * K : (c + 1) * K, :] = F
+            # store-narrow: a bf16 stage takes the cast here; the f32 DP
+            # carry (prev_f) and the score accumulator never narrow
+            stage_f[c * K : (c + 1) * K, :] = F.astype(stage_f.dtype)
 
             @pl.when(j == tlen)
             def _():
@@ -444,7 +452,7 @@ def _mega_kernel(
             Fr = Gr + _cumop_rev(candr - Gr, jnp.maximum, K)
             Fr = jnp.where(validr, Fr, neg)
             prev_r = Fr
-            stage_r[c * K : (c + 1) * K, :] = Fr
+            stage_r[c * K : (c + 1) * K, :] = Fr.astype(stage_r.dtype)
 
         fcarry[:] = prev_f
         rcarry[:] = prev_r
@@ -521,9 +529,15 @@ def _mega_kernel(
             j = jb2 * C + c
 
             # ---- dense all-edits column (dense_pallas._dense_kernel) -
-            A_j = stage_f[c * K : (c + 1) * K, :]
-            B_j = rolled[(C + 1 - c) * K : (C + 2 - c) * K, :]
-            B_n = rolled[(C - c) * K : (C + 1 - c) * K, :]
+            # load-wide: the band stage may be narrower (bf16); every
+            # max-plus candidate and join below accumulates in f32
+            A_j = stage_f[c * K : (c + 1) * K, :].astype(jnp.float32)
+            B_j = rolled[(C + 1 - c) * K : (C + 2 - c) * K, :].astype(
+                jnp.float32
+            )
+            B_n = rolled[(C - c) * K : (C + 1 - c) * K, :].astype(
+                jnp.float32
+            )
 
             A_up = pltpu.roll(A_j, K - 1, axis=0)
             A_up = jnp.where(d == K - 1, neg, A_up)
@@ -644,7 +658,8 @@ def _mega_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("K", "T1p", "C", "want_stats", "interpret"),
+    static_argnames=("K", "T1p", "C", "want_stats", "interpret",
+                     "band_dtype"),
 )
 def _mega_call(
     tlen_s,  # [1, 1] int32
@@ -658,11 +673,13 @@ def _mega_call(
     C: int,
     want_stats: bool = False,
     interpret: bool = False,
+    band_dtype: str = "f32",
 ):
     n_steps = T1p // C
     Npad = meta6.shape[2]
     NB = Npad // LANES
     CB = C + K
+    band_dt = band_store_dtype(band_dtype)
     grid = (NB, 2 * n_steps)
 
     def smem_spec():
@@ -750,11 +767,13 @@ def _mega_call(
         )
 
     scratch = [
-        pltpu.ANY((T1p * K, LANES), jnp.float32),  # band_f
-        pltpu.ANY((T1p * K, LANES), jnp.float32),  # band_r (mirrored)
-        pltpu.VMEM((C * K, LANES), jnp.float32),  # stage_f
-        pltpu.VMEM((C * K, LANES), jnp.float32),  # stage_r
-        pltpu.VMEM(((C + 2) * K, LANES), jnp.float32),  # stage_b
+        # the launch-private band round trip — the megakernel's dominant
+        # byte term — carries the band-store dtype end to end
+        pltpu.ANY((T1p * K, LANES), band_dt),  # band_f
+        pltpu.ANY((T1p * K, LANES), band_dt),  # band_r (mirrored)
+        pltpu.VMEM((C * K, LANES), band_dt),  # stage_f
+        pltpu.VMEM((C * K, LANES), band_dt),  # stage_r
+        pltpu.VMEM(((C + 2) * K, LANES), band_dt),  # stage_b
         pltpu.SemaphoreType.DMA,
         pltpu.VMEM((K, LANES), jnp.float32),  # fcarry
         pltpu.VMEM((K, LANES), jnp.float32),  # rcarry
@@ -773,7 +792,7 @@ def _mega_call(
     return pl.pallas_call(
         functools.partial(
             _mega_kernel, K=K, C=C, n_steps=n_steps,
-            want_stats=want_stats,
+            want_stats=want_stats, band_neg=neg_inf_for(band_dt),
         ),
         grid=grid,
         in_specs=in_specs,
@@ -805,6 +824,7 @@ def fused_tables_mega(
     want_stats: bool = False,
     off_override=None,
     interpret: bool = False,
+    band_dtype: str = "f32",
 ):
     """One fused consensus step in a SINGLE Pallas launch — same dict
     contract as dense_pallas.fused_tables_pallas (minus want_moves,
@@ -834,6 +854,7 @@ def fused_tables_mega(
         prep["tlen_s"], prep["off_s"], prep["t_cols"], prep["meta6"],
         prep["fwd_tabs"], prep["rev_tabs"],
         K=K, T1p=T1p, C=C, want_stats=want_stats, interpret=interpret,
+        band_dtype=band_dtype,
     )
     outs = list(outs)
     dense_out = outs.pop(0)
@@ -883,6 +904,7 @@ def fused_tables_auto(
     interpret: bool = False,
     impl=None,
     vmem_budget=None,
+    band_dtype: str = "f32",
 ):
     """Route one fused step to the megakernel or the 3-launch split
     oracle (same dict contract either way, plus out["impl"] naming the
@@ -899,14 +921,14 @@ def fused_tables_auto(
         out = fused_tables_mega(
             template, tlen, bufs, geom, weights, K, T1p, Cm,
             want_stats=want_stats, off_override=off_override,
-            interpret=interpret,
+            interpret=interpret, band_dtype=band_dtype,
         )
     else:
         out = fused_tables_pallas(
             template, tlen, bufs, geom, weights, K, T1p, C,
             want_stats=want_stats, want_moves=want_moves,
             off_override=off_override, slen_min=slen_min,
-            interpret=interpret,
+            interpret=interpret, band_dtype=band_dtype,
         )
     out["impl"] = sel
     return out
@@ -914,16 +936,18 @@ def fused_tables_auto(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("K", "T1p", "C", "want_stats", "interpret"),
+    static_argnames=("K", "T1p", "C", "want_stats", "interpret",
+                     "band_dtype"),
 )
 def _fused_step_mega(
     template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
     K: int, T1p: int, C: int,
     want_stats: bool = False, interpret: bool = False,
+    band_dtype: str = "f32",
 ):
     out = fused_tables_mega(
         template, tlen, bufs, geom, weights, K, T1p, C,
-        want_stats=want_stats, interpret=interpret,
+        want_stats=want_stats, interpret=interpret, band_dtype=band_dtype,
     )
     return jnp.concatenate(pack_parts(out, want_stats))
 
@@ -932,7 +956,7 @@ def fused_step_auto(
     template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
     K: int, T1p: int, C: int,
     want_stats: bool = False, want_moves: bool = False,
-    interpret: bool = False, impl=None,
+    interpret: bool = False, impl=None, band_dtype: str = "f32",
 ):
     """Packed-single-fetch dispatcher (dense_pallas.fused_step_pallas's
     contract: (packed, moves-or-None)) routing to the megakernel when
@@ -948,9 +972,11 @@ def fused_step_auto(
         packed = _fused_step_mega(
             template, tlen, bufs, geom, weights, K, T1p, Cm,
             want_stats=want_stats, interpret=interpret,
+            band_dtype=band_dtype,
         )
         return packed, None
     return fused_step_pallas(
         template, tlen, bufs, geom, weights, K, T1p, C,
         want_stats=want_stats, want_moves=want_moves, interpret=interpret,
+        band_dtype=band_dtype,
     )

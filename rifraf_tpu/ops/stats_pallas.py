@@ -111,8 +111,14 @@ def _stats_kernel(
     C: int,
     want_tiles: bool = True,
     has_carry: bool = False,
+    want_edge: bool = False,
 ):
     refs = list(refs)
+    # want_edge appends the per-lane TRUE band limits (delta, nd) after
+    # the read-base table: the uniform frame widens every lane to the
+    # shared K, so the frame rows 0 / K-1 are NOT the band edges
+    delta_ref = refs.pop(0) if want_edge else None
+    nd_ref = refs.pop(0) if want_edge else None
     carry_in = refs.pop(0) if has_carry else None
     tiles_ref = refs.pop(0)
     acc_ref = refs.pop(0)
@@ -144,6 +150,10 @@ def _stats_kernel(
     P = P_scr[:] > 0
     nerr = acc_scr[0:1, :]
     reached = acc_scr[1:2, :]
+    ehits = acc_scr[2:3, :]
+    if want_edge:
+        edge_lo = delta_ref[0, 0, :][None, :]
+        edge_hi = (delta_ref[0, 0, :] + nd_ref[0, 0, :] - 1)[None, :]
 
     # columns DESCEND within the block (the sweep chains P toward j-1)
     for c in range(C - 1, -1, -1):
@@ -181,6 +191,13 @@ def _stats_kernel(
         )
         reached = reached | jnp.where(j == 0, r0, zero_i)
 
+        if want_edge:
+            # on-path cells pinned to a band-limit row: the adaptive
+            # growth frontier signal (one count per column crossed)
+            hit = on & ((d == edge_lo) | (d == edge_hi))
+            ehits = ehits + jnp.sum(hit.astype(jnp.int32), axis=0,
+                                    keepdims=True, dtype=jnp.int32)
+
         if want_tiles:
             def any_row(m):
                 return jnp.max(m.astype(jnp.float32), axis=0, keepdims=True)
@@ -202,8 +219,11 @@ def _stats_kernel(
         P = is_m | (is_d_dn > 0)
 
     P_scr[:] = P.astype(jnp.int32)
+    # row 2 carries the edge-hit count; it stays all-zero (bit-identical
+    # to the historical layout) unless want_edge accumulated into it
     acc_new = jnp.concatenate(
-        [nerr, reached, jnp.zeros((CARRY_ROWS - 2, LANES), jnp.int32)],
+        [nerr, reached, ehits,
+         jnp.zeros((CARRY_ROWS - 3, LANES), jnp.int32)],
         axis=0,
     )
     acc_scr[:] = acc_new
@@ -218,7 +238,8 @@ def _stats_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("K", "T1p", "NB", "C", "want_tiles", "interpret"),
+    static_argnames=("K", "T1p", "NB", "C", "want_tiles", "interpret",
+                     "want_edge"),
 )
 def _stats_call(
     tlen_s,  # [1, 1] int32
@@ -235,6 +256,9 @@ def _stats_call(
     interpret: bool = False,
     col0=None,  # [1, 1] int32 global first column (panel launches)
     carry_in=None,  # [K + 8, NB*128] int32 previous panel's state
+    want_edge: bool = False,
+    delta=None,  # [1, nlanes] int32 per-lane frame shift (want_edge)
+    ndv=None,  # [1, nlanes] int32 per-lane TRUE band height (want_edge)
 ):
     """One reverse stats sweep over ``T1p`` columns and ``NB`` forward
     lane blocks (``mv_flat``/``sq``/``dend`` may carry extra reversed
@@ -284,6 +308,13 @@ def _stats_call(
         tlen_s, off_s, jnp.asarray(col0, jnp.int32).reshape(1, 1),
         t_cols, dend[None], mv_flat, sq,
     ]
+    if want_edge:
+        lane_spec = pl.BlockSpec(
+            (1, 1, LANES), lambda nb, jb: (0, 0, nb),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [lane_spec, lane_spec]
+        args += [delta[None], ndv[None]]
     if has_carry:
         in_specs.append(
             pl.BlockSpec(
@@ -334,7 +365,7 @@ def _stats_call(
     outs = pl.pallas_call(
         functools.partial(
             _stats_kernel, K=K, C=C, want_tiles=want_tiles,
-            has_carry=has_carry,
+            has_carry=has_carry, want_edge=want_edge,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -380,6 +411,16 @@ def _finish_nerr(acc, Npad: int):
     )
 
 
+def _finish_edge(acc, Npad: int):
+    """Per-lane band-edge hit counts (acc row 2); incomplete paths
+    report 0 — they never trigger growth anyway (n_errors = -1 sits
+    below every threshold), matching the XLA want_edge path's contract
+    that the signal only matters on complete, flagged reads."""
+    return jnp.where(acc[1, :Npad] > 0, acc[2, :Npad], 0).astype(
+        jnp.int32
+    )
+
+
 def traceback_stats_pallas(
     prep: dict,  # prepare_fill output (tlen_s/off_s/t_cols/meta/fwd_tabs)
     mv_flat,  # [T1p * K, nlanes] int32 move band straight from _fill_call
@@ -390,24 +431,35 @@ def traceback_stats_pallas(
     T1: int,  # template length + 1 (sizes the edits table)
     want_edits: bool = True,
     interpret: bool = False,
+    want_edge: bool = False,
 ):
     """Stats for a single-launch fill: reuses the fill's prepared
     inputs verbatim (same C, same blocked read-base table, dend from the
     same meta — so the sweep sees exactly the frame the moves were
     recorded in). Returns (n_errors [Npad] int32, edits [T1, 9] int8 or
-    None)."""
+    None), plus a trailing (edge_hits [Npad] int32) when ``want_edge``
+    (per-lane true band limits ride in from the same meta rows the fill
+    masked with)."""
     NB = Npad // LANES
+    kw = {}
+    if want_edge:
+        kw = dict(
+            want_edge=True, delta=prep["meta"][1], ndv=prep["meta"][2],
+        )
     tiles, acc = _stats_call(
         prep["tlen_s"], prep["off_s"], prep["t_cols"][:1], prep["meta"][3],
         mv_flat, prep["fwd_tabs"][4],
         K=K, T1p=T1p, NB=NB, C=C, want_tiles=want_edits,
-        interpret=interpret,
+        interpret=interpret, **kw,
     )
     nerr = _finish_nerr(acc, Npad)
-    if not want_edits:
-        return nerr, None
-    um = jnp.max(tiles.reshape(T1p, ROWS, NB * LANES), axis=2)[:T1]
-    return nerr, _edits_from_union(um > 0.0)
+    edits = None
+    if want_edits:
+        um = jnp.max(tiles.reshape(T1p, ROWS, NB * LANES), axis=2)[:T1]
+        edits = _edits_from_union(um > 0.0)
+    if want_edge:
+        return nerr, edits, _finish_edge(acc, Npad)
+    return nerr, edits
 
 
 @functools.partial(

@@ -68,13 +68,18 @@ from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..engine.bandgrowth import (
+    ADAPTIVE_ENTRY_BW,
+    MAX_BANDWIDTH_DOUBLINGS,  # noqa: F401  (re-exported; model.jl:650)
+    adaptive_entry,
+    check_band_growth,
+    grow_bandwidths,
+)
 from ..models.sequences import ReadScores, batch_reads
 from ..utils.mathops import logsumexp10, poisson_cquantile
 from ..utils.shapes import LANES, pack_segments
 from ..utils.shapes import bucket as _bucket
 from .cluster import pipeline_map
-
-MAX_BANDWIDTH_DOUBLINGS = 5  # model.jl:650
 
 # bucketed-scheduler grid defaults: read-count and band-height rounding
 READ_BUCKET = 8
@@ -158,6 +163,13 @@ class SweepStats(NamedTuple):
     # which is bounded by the read-count bucket grid, not by packing
     lane_occupancy: float = 1.0
     lane_occupancy_reads: float = 1.0
+    # precision / growth-policy provenance of the run, plus the settled
+    # per-read bandwidth histogram ((bandwidth, count), ...) over live
+    # lanes — the adaptive policy's win shows up here as mass staying at
+    # small bandwidths instead of doubling to the worst read's
+    band_dtype: str = "f32"
+    band_growth: str = "double"
+    bw_hist: Tuple = ()
 
 
 class BucketPlan(NamedTuple):
@@ -204,12 +216,33 @@ class _ClusterInfo(NamedTuple):
     useful: int  # sum of read lengths
 
 
+def _settled_bw_hist(chunks: Sequence[np.ndarray]) -> Tuple:
+    """((bandwidth, count), ...) over the settled live-lane bandwidths
+    every executed chunk reported via bw_sink. Journal-replayed chunks
+    never re-run, so a resumed sweep's histogram covers only the chunks
+    executed THIS call."""
+    if not chunks:
+        return ()
+    vals, counts = np.unique(np.concatenate(chunks), return_counts=True)
+    return tuple((int(v), int(c)) for v, c in zip(vals, counts))
+
+
 def _cluster_infos(
     clusters: Sequence[Sequence[ReadScores]],
+    band_growth: str = "double",
 ) -> List[_ClusterInfo]:
     """Host-side per-cluster facts the planner and packer share. The
     seed is the read with the best logsumexp10(match_scores)
-    (model.jl:575-579) — computed once here, reused by packing."""
+    (model.jl:575-579) — computed once here, reused by packing.
+
+    ``band_growth="adaptive"`` computes ``entry_k`` from the LOWERED
+    entry bandwidths (min(bandwidth, 16), engine.bandgrowth) the
+    executor actually enters adaptation with, so well-behaved clusters
+    bucket onto small-K shapes instead of the caller's default band."""
+
+    def ebw(b: int) -> int:
+        return min(b, ADAPTIVE_ENTRY_BW) if band_growth == "adaptive" else b
+
     infos = []
     for c in clusters:
         k = int(np.argmax([logsumexp10(r.match_scores) for r in c]))
@@ -220,17 +253,18 @@ def _cluster_infos(
             seed_idx=k,
             tlen0=tlen0,
             entry_k=max(
-                2 * r.bandwidth + abs(len(r) - tlen0) + 1 for r in c
+                2 * ebw(r.bandwidth) + abs(len(r) - tlen0) + 1 for r in c
             ),
             useful=sum(len(r) for r in c),
         ))
     return infos
 
 
-def cluster_info(cluster: Sequence[ReadScores]) -> _ClusterInfo:
+def cluster_info(cluster: Sequence[ReadScores],
+                 band_growth: str = "double") -> _ClusterInfo:
     """Per-cluster shape/seed facts for ONE cluster (the serving
     admission path computes these once per request)."""
-    return _cluster_infos([cluster])[0]
+    return _cluster_infos([cluster], band_growth)[0]
 
 
 def _content_digest(clusters: Sequence[Sequence[ReadScores]]) -> str:
@@ -347,6 +381,7 @@ def plan_sweep(
     lane_target: int = LANE_TARGET,
     segment_pack: Optional[bool] = None,
     segment_align: int = 1,
+    band_growth: str = "double",
 ) -> List[BucketPlan]:
     """Group clusters into shape buckets and chunk each bucket's cluster
     axis. Pure host arithmetic — no JAX — so planner invariants are
@@ -391,7 +426,7 @@ def plan_sweep(
     if scheduler not in ("bucketed", "uniform"):
         raise ValueError(f"unknown sweep scheduler: {scheduler!r}")
     if infos is None:
-        infos = _cluster_infos(clusters)
+        infos = _cluster_infos(clusters, band_growth)
     if not infos:
         return []
 
@@ -508,11 +543,13 @@ def plan_cells(plans: Sequence[BucketPlan]) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _adapt_program(Tmax: int, K: int):
+def _adapt_program(Tmax: int, K: int, want_edge: bool = False,
+                   band_dtype: str = "f32"):
     """One adaptive-bandwidth round for a whole chunk: vmapped fill +
-    traceback statistics, n_errors [G, N] out. Module-level cache so
-    repeated sweep calls reuse the jitted wrapper (a fresh jax.jit per
-    call would recompile every round of every call)."""
+    traceback statistics, n_errors [G, N] out (plus edge_hits [G, N]
+    when ``want_edge``, for the adaptive growth policy). Module-level
+    cache so repeated sweep calls reuse the jitted wrapper (a fresh
+    jax.jit per call would recompile every round of every call)."""
     import jax
 
     from ..ops import align_jax
@@ -523,17 +560,22 @@ def _adapt_program(Tmax: int, K: int):
         geom = align_jax.BandGeometry.make(lengths_g, tlen_g, bw_g)
         _, _, _, packed = fused_step_full(
             tmpl_g[:Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g,
-            geom, w_g, K, False, True, 0, False,
+            geom, w_g, K, False, True, 0, False, want_edge, band_dtype,
         )
-        lay = pack_layout(seq_g.shape[0], Tmax + 1, True, False)
-        return packed[slice(*lay["n_errors"])]
+        lay = pack_layout(seq_g.shape[0], Tmax + 1, True, False,
+                          want_edge)
+        n_err = packed[slice(*lay["n_errors"])]
+        if want_edge:
+            return n_err, packed[slice(*lay["edge_hits"])]
+        return n_err
 
     return jax.jit(jax.vmap(one))
 
 
 @functools.lru_cache(maxsize=None)
 def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
-                   use_edits: bool, donate: bool):
+                   use_edits: bool, donate: bool,
+                   band_dtype: str = "f32"):
     """The whole INIT stage for a chunk, vmapped over the cluster axis.
     One cached program per (Tmax, K, H, min_dist, gate) signature; XLA's
     jit cache then keys on the batch avals, so every chunk of a bucket
@@ -553,7 +595,7 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
         geom = align_jax.BandGeometry.make(lengths_g, tlen, bw_g)
         _, _, _, packed = fused_step_full(
             tmpl[:Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g, geom,
-            w_g, K, False, use_edits, 0,
+            w_g, K, False, use_edits, 0, band_dtype=band_dtype,
         )
         return unpack_tables(packed, seq_g.shape[0], Tmax + 1, use_edits)
 
@@ -574,7 +616,8 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _seg_adapt_program(Tmax: int, K: int, S: int):
+def _seg_adapt_program(Tmax: int, K: int, S: int,
+                       want_edge: bool = False, band_dtype: str = "f32"):
     """Segment-packed adaptive-bandwidth round: per-lane traceback
     error counts for a chunk of packs, each lane filled against ITS
     segment's template. Per-lane values are identical to the
@@ -588,8 +631,11 @@ def _seg_adapt_program(Tmax: int, K: int, S: int):
         out = fused_step_segmented(
             tmpl_g, tlen_g, seg_g, seq_g, match_g, mismatch_g, ins_g,
             dels_g, lengths_g, bw_g, w_g, K, S,
-            want_stats=True, want_tables=False,
+            want_stats=True, want_tables=False, want_edge=want_edge,
+            band_dtype=band_dtype,
         )
+        if want_edge:
+            return out["n_errors"], out["edge_hits"]
         return out["n_errors"]
 
     return jax.jit(jax.vmap(one))
@@ -597,7 +643,8 @@ def _seg_adapt_program(Tmax: int, K: int, S: int):
 
 @functools.lru_cache(maxsize=None)
 def _seg_stage_program(Tmax: int, K: int, H: int, min_dist: int,
-                       use_edits: bool, donate: bool, S: int):
+                       use_edits: bool, donate: bool, S: int,
+                       band_dtype: str = "f32"):
     """The whole INIT stage for a chunk of SEGMENT-PACKED blocks: S
     clusters share each block's lane axis, hill-climbing jointly via
     the segment stage runner, vmapped over the pack axis. Same cache
@@ -616,6 +663,7 @@ def _seg_stage_program(Tmax: int, K: int, H: int, min_dist: int,
             tmpls, tlens, seg_g, seq_g, match_g, mismatch_g, ins_g,
             dels_g, lengths_g, bw_g, w_g, K, S,
             want_stats=use_edits, want_tables=True,
+            band_dtype=band_dtype,
         )
         tabs = (out["total"], out["sub"], out["ins"], out["del"])
         if use_edits:
@@ -656,13 +704,18 @@ class ChunkExecutor:
 
     def __init__(self, mesh=None, max_iters: int = 100, min_dist: int = 15,
                  bandwidth_pvalue: float = 0.1,
-                 do_alignment_proposals: bool = False, device=None):
+                 do_alignment_proposals: bool = False, device=None,
+                 band_dtype: str = "f32", band_growth: str = "double",
+                 bw_sink=None):
         import jax
 
         from ..engine.params import resolve_dtype
 
         if mesh is not None and device is not None:
             raise ValueError("pass mesh OR device, not both")
+        if band_dtype not in ("f32", "bf16"):
+            raise ValueError(f"unknown band_dtype: {band_dtype!r}")
+        check_band_growth(band_growth)
         self.mesh = mesh
         self.device = device
         self.max_iters = max_iters
@@ -672,6 +725,15 @@ class ChunkExecutor:
         self.use_edits = do_alignment_proposals
         self.dtype = resolve_dtype(None)
         self.donate = jax.default_backend() != "cpu"
+        # the cluster-axis mesh shards plain vmapped programs, which
+        # compile fine at either band dtype / growth policy (unlike
+        # realign's read-axis shard_map wrappers) — no mesh escape hatch
+        self.band_dtype = band_dtype
+        self.band_growth = band_growth
+        # optional callable fed the SETTLED bandwidths of each chunk's
+        # live lanes — sweep-level accounting without widening the
+        # run()/collect() handle protocol the serving path relies on
+        self.bw_sink = bw_sink
 
     def _shard(self, a, *spec):
         """Device placement of one input array: sharded over the mesh
@@ -782,6 +844,11 @@ class ChunkExecutor:
         entry_bw = bandwidths.copy()
         fixed = np.zeros_like(weights, bool)
         fixed[weights == 0] = True
+        adaptive = self.band_growth == "adaptive"
+        if adaptive:
+            bandwidths = np.where(
+                fixed, bandwidths, adaptive_entry(bandwidths)
+            )
         old_errors = np.full(lengths.shape, np.iinfo(np.int64).max)
         for _ in range(MAX_BANDWIDTH_DOUBLINGS + 1):
             K = _bucket(
@@ -789,25 +856,25 @@ class ChunkExecutor:
                      + 1).max()),
                 plan.band,
             )
-            n_err = np.asarray(_adapt_program(Tmax, K)(
+            out = _adapt_program(Tmax, K, adaptive, self.band_dtype)(
                 sq_d, mt_d, mm_d, gi_d, dl_d, ln_d,
                 shard(bandwidths, None), w_d, t0_d, tl_d,
-            )).astype(np.int64)
-            max_bw = np.minimum(
-                np.minimum(entry_bw << MAX_BANDWIDTH_DOUBLINGS,
-                           tlens0[:, None]),
-                lengths,
             )
-            grow = (~fixed) & (n_err > p["thresholds"]) & (
-                n_err < old_errors
-            ) & (bandwidths < max_bw)
-            fixed |= ~grow
-            if not grow.any():
+            if adaptive:
+                n_err = np.asarray(out[0]).astype(np.int64)
+                edge = np.asarray(out[1]).astype(np.int64)
+            else:
+                n_err = np.asarray(out).astype(np.int64)
+                edge = None
+            bandwidths, fixed, old_errors = grow_bandwidths(
+                bandwidths, fixed, old_errors, n_err, p["thresholds"],
+                entry_bw, tlens0[:, None], lengths,
+                band_growth=self.band_growth, edge_hits=edge,
+            )
+            if fixed.all():
                 break
-            old_errors = np.where(grow, n_err, old_errors)
-            bandwidths = np.where(
-                grow, np.minimum(bandwidths * 2, max_bw), bandwidths
-            )
+        if self.bw_sink is not None:
+            self.bw_sink(bandwidths[weights > 0])
 
         # ---- the whole INIT stage, vmapped over clusters: dispatch
         # only; the fetch is deferred to collect() ----
@@ -821,7 +888,8 @@ class ChunkExecutor:
             shard(bandwidths, None), w_d,
         )
         packed = _stage_program(
-            Tmax, K, self.H, self.min_dist, self.use_edits, self.donate
+            Tmax, K, self.H, self.min_dist, self.use_edits, self.donate,
+            self.band_dtype,
         )(t0_d, tl_d, step_state)
         return packed, plan, idxs
 
@@ -950,6 +1018,11 @@ class ChunkExecutor:
         entry_bw = bandwidths.copy()
         fixed = np.zeros_like(weights, bool)
         fixed[weights == 0] = True
+        adaptive = self.band_growth == "adaptive"
+        if adaptive:
+            bandwidths = np.where(
+                fixed, bandwidths, adaptive_entry(bandwidths)
+            )
         old_errors = np.full(lengths.shape, np.iinfo(np.int64).max)
         for _ in range(MAX_BANDWIDTH_DOUBLINGS + 1):
             K = _bucket(
@@ -957,25 +1030,26 @@ class ChunkExecutor:
                      + 1).max()),
                 plan.band,
             )
-            n_err = np.asarray(_seg_adapt_program(Tmax, K, S)(
+            out = _seg_adapt_program(Tmax, K, S, adaptive,
+                                     self.band_dtype)(
                 sq_d, mt_d, mm_d, gi_d, dl_d, ln_d,
                 shard(bandwidths, None), w_d, sg_d, t0_d, tl_d,
-            )).astype(np.int64)
-            max_bw = np.minimum(
-                np.minimum(entry_bw << MAX_BANDWIDTH_DOUBLINGS,
-                           tlen_lane),
-                lengths,
             )
-            grow = (~fixed) & (n_err > p["thresholds"]) & (
-                n_err < old_errors
-            ) & (bandwidths < max_bw)
-            fixed |= ~grow
-            if not grow.any():
+            if adaptive:
+                n_err = np.asarray(out[0]).astype(np.int64)
+                edge = np.asarray(out[1]).astype(np.int64)
+            else:
+                n_err = np.asarray(out).astype(np.int64)
+                edge = None
+            bandwidths, fixed, old_errors = grow_bandwidths(
+                bandwidths, fixed, old_errors, n_err, p["thresholds"],
+                entry_bw, tlen_lane, lengths,
+                band_growth=self.band_growth, edge_hits=edge,
+            )
+            if fixed.all():
                 break
-            old_errors = np.where(grow, n_err, old_errors)
-            bandwidths = np.where(
-                grow, np.minimum(bandwidths * 2, max_bw), bandwidths
-            )
+        if self.bw_sink is not None:
+            self.bw_sink(bandwidths[weights > 0])
 
         K = _bucket(
             int((2 * bandwidths + np.abs(lengths - tlen_lane)
@@ -988,7 +1062,7 @@ class ChunkExecutor:
         )
         packed = _seg_stage_program(
             Tmax, K, self.H, self.min_dist, self.use_edits, self.donate,
-            S,
+            S, self.band_dtype,
         )(t0_d, tl_d, lv_d, step_state)
         return packed, plan, packs
 
@@ -1032,6 +1106,8 @@ def sweep_clusters_sharded(
     n_workers: int = 1,
     journal_path: str = "",
     resume: bool = False,
+    band_dtype: str = "f32",
+    band_growth: str = "double",
 ):
     """One consensus per cluster, all clusters in one device program.
 
@@ -1087,14 +1163,15 @@ def sweep_clusters_sharded(
 
     for gi, c in enumerate(clusters):
         validate_encoded_cluster(c, source=f"sweep cluster {gi}")
-    infos = _cluster_infos(clusters)
+    check_band_growth(band_growth)
+    infos = _cluster_infos(clusters, band_growth)
     n_axis = mesh.devices.size if mesh is not None else 1
     plans = plan_sweep(
         clusters, scheduler=scheduler, read_bucket=read_bucket,
         band_bucket=band_bucket, len_bucket=len_bucket,
         cluster_chunk=cluster_chunk, n_axis=n_axis, infos=infos,
         lane_target=lane_target, segment_pack=segment_pack,
-        segment_align=segment_align,
+        segment_align=segment_align, band_growth=band_growth,
     )
     if G == 0:
         stats = SweepStats(0, 0, 0, 0, 0, 0.0, 0, 0.0, [])
@@ -1103,6 +1180,15 @@ def sweep_clusters_sharded(
     if n_workers > 1 and mesh is not None:
         raise ValueError("n_workers > 1 is the per-device fleet; "
                          "pass mesh OR n_workers, not both")
+    # settled per-read bandwidths of every chunk's live lanes, for the
+    # SweepStats histogram (lock-shared across fleet worker threads)
+    settled_bw: List[np.ndarray] = []
+    bw_lock = threading.Lock()
+
+    def bw_sink(bw):
+        with bw_lock:
+            settled_bw.append(np.asarray(bw).ravel())
+
     if n_workers > 1:
         import jax
 
@@ -1112,6 +1198,8 @@ def sweep_clusters_sharded(
                 device=devs[i % len(devs)], max_iters=max_iters,
                 min_dist=min_dist, bandwidth_pvalue=bandwidth_pvalue,
                 do_alignment_proposals=do_alignment_proposals,
+                band_dtype=band_dtype, band_growth=band_growth,
+                bw_sink=bw_sink if return_stats else None,
             )
             for i in range(n_workers)
         ]
@@ -1120,6 +1208,8 @@ def sweep_clusters_sharded(
             mesh=mesh, max_iters=max_iters, min_dist=min_dist,
             bandwidth_pvalue=bandwidth_pvalue,
             do_alignment_proposals=do_alignment_proposals,
+            band_dtype=band_dtype, band_growth=band_growth,
+            bw_sink=bw_sink if return_stats else None,
         )]
 
     tasks = [
@@ -1146,6 +1236,7 @@ def sweep_clusters_sharded(
             bandwidth_pvalue, len_bucket, cluster_chunk, scheduler,
             read_bucket, band_bucket, do_alignment_proposals,
             lane_target, segment_pack, segment_align,
+            band_dtype, band_growth,
         )
         journal, prior = open_resumable(
             journal_path,
@@ -1319,5 +1410,8 @@ def sweep_clusters_sharded(
         lane_occupancy_reads=(
             reads_used / slots_total if slots_total else 1.0
         ),
+        band_dtype=band_dtype,
+        band_growth=band_growth,
+        bw_hist=_settled_bw_hist(settled_bw),
     )
     return list(out), stats

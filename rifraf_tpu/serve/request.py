@@ -112,6 +112,13 @@ class ServeConfig:
     min_dist: int = 5 * CODON_LENGTH
     bandwidth_pvalue: float = 0.1
     do_alignment_proposals: bool = False
+    # band-table storage precision ("f32" | "bf16") and bandwidth growth
+    # policy ("double" | "adaptive") — see engine.params.RifrafParams.
+    # Both change compiled executables and numeric results, so they are
+    # part of the spool fingerprint: a --resume across a changed value
+    # is refused instead of silently mixing precisions
+    band_dtype: str = "f32"
+    band_growth: str = "double"
     # scores/bandwidth used by encode_cluster() and the singleton
     # fallback path; clusters submitted as ready-made ReadScores must
     # have been built with the SAME values or fallback results will not

@@ -272,7 +272,7 @@ class ConsensusServer:
             self.stats.count("rejected_invalid")
             raise InvalidRequestError(f"[{e.code}] {e}") from e
         cfg = self.config
-        info = cluster_info(cluster)
+        info = cluster_info(cluster, cfg.band_growth)
         if info.n_reads > cfg.max_reads or info.max_len > cfg.max_len:
             raise OversizeError(
                 f"cluster shape ({info.n_reads} reads, max len "
@@ -500,7 +500,7 @@ class ConsensusServer:
         cfg = self.config
         by_key = {}
         for c in example_clusters:
-            info = cluster_info(c)
+            info = cluster_info(c, cfg.band_growth)
             key = bucket_key(info, cfg.read_bucket, cfg.band_bucket,
                              cfg.len_bucket)
             by_key.setdefault(key, (list(c), info))

@@ -152,6 +152,8 @@ class Worker:
             bandwidth_pvalue=config.bandwidth_pvalue,
             do_alignment_proposals=config.do_alignment_proposals,
             device=device,
+            band_dtype=config.band_dtype,
+            band_growth=config.band_growth,
         )
         # supervision surface: the supervisor reads these to detect a
         # crashed/stalled worker and to recover its in-flight requests
@@ -362,6 +364,8 @@ class Worker:
                     max_iters=cfg.max_iters, min_dist=cfg.min_dist,
                     bandwidth_pvalue=cfg.bandwidth_pvalue,
                     bandwidth=cfg.bandwidth, scores=cfg.scores,
+                    band_dtype=cfg.band_dtype,
+                    band_growth=cfg.band_growth,
                 ),
             )
         self.stats.count("fallback")

@@ -45,16 +45,21 @@ def fill_model(
     n_streams: int = 2,
     want_moves: bool = False,
     moves_lanes: Optional[int] = None,
+    band_itemsize: int = _F32,
 ) -> Dict[str, float]:
     """HBM bytes + VPU ops for one fill dispatch: 5 blocked tables per
     stream read once per grid step (halo'd: C+K rows per C columns),
     the band written once, and — with ``want_moves`` — the int32 move
     band written once across ``moves_lanes`` lanes (the fused layout
-    launches fwd+rev lanes but only fills the forward half)."""
+    launches fwd+rev lanes but only fills the forward half).
+
+    ``band_itemsize`` is the HBM store width of the band tables
+    (params.band_dtype: 4 for f32, 2 for bf16) — the emission tables
+    and move codes stay 4-byte regardless."""
     n_steps = T1p // C
     CB = C + K
     tab = n_streams * 5 * n_steps * CB * Npad * _F32
-    band = n_streams * K * T1p * Npad * _F32
+    band = n_streams * K * T1p * Npad * band_itemsize
     moves = 0
     if want_moves:
         moves = K * T1p * (moves_lanes if moves_lanes else Npad) * _F32
@@ -67,18 +72,22 @@ def fill_model(
             "moves_bytes": float(moves)}
 
 
-def dense_model(T1p: int, K: int, Npad: int, C: int) -> Dict[str, float]:
+def dense_model(T1p: int, K: int, Npad: int, C: int,
+                band_itemsize: int = _F32) -> Dict[str, float]:
     """HBM bytes + VPU ops for the dense candidate-tables kernel plus
     the backward-alignment halo program that feeds it: the halo program
     reads the raw reversed band once and writes the halo-blocked copy;
     the kernel reads the forward half of the band, the halo-blocked
     backward band, and the 5 forward tables again, and writes the
-    [T1p, 16, Npad] per-column join maxima."""
+    [T1p, 16, Npad] per-column join maxima. All band traffic scales
+    with ``band_itemsize`` (params.band_dtype); tables and output tiles
+    stay 4-byte."""
     n_steps = T1p // C
     CB = C + K
-    bh = n_steps * (C + 1) * K * Npad * _F32
-    halo_src = K * T1p * Npad * _F32  # raw Brev read by the halo program
-    rd = K * T1p * Npad * _F32 + bh + 5 * n_steps * CB * Npad * _F32
+    bh = n_steps * (C + 1) * K * Npad * band_itemsize
+    halo_src = K * T1p * Npad * band_itemsize  # raw Brev read (halo prog)
+    rd = (K * T1p * Npad * band_itemsize + bh
+          + 5 * n_steps * CB * Npad * _F32)
     out = T1p * 16 * Npad * _F32
     # per column per base: 2 scans + joins over K rows, 9 outputs
     ops = T1p * Npad * K * (8 * (4 + 2 * math.log2(K)) + 10)
@@ -113,13 +122,14 @@ def fused_model(
     C: int,
     want_stats: bool = False,
     stats_itemsize: int = 4,
+    band_itemsize: int = _F32,
 ) -> Dict[str, float]:
     """One fused consensus step: two-stream fill + backward halo +
     dense tables, plus — with ``want_stats`` — the move-band write and
     the reverse stats sweep."""
     f = fill_model(T1p, K, Npad, C, n_streams=2, want_moves=want_stats,
-                   moves_lanes=2 * Npad)
-    d = dense_model(T1p, K, Npad, C)
+                   moves_lanes=2 * Npad, band_itemsize=band_itemsize)
+    d = dense_model(T1p, K, Npad, C, band_itemsize=band_itemsize)
     total = f["bytes"] + d["bytes"]
     ops = f["ops"] + d["ops"]
     parts = {"fill": f, "dense": d}
@@ -138,6 +148,7 @@ def fused_mega_model(
     C: int,
     want_stats: bool = False,
     spread: int = 0,
+    band_itemsize: int = _F32,
 ) -> Dict[str, float]:
     """One SINGLE-LAUNCH fused step (ops.fused_pallas megakernel): the
     band bytes are counted ONCE per direction — each stream's band is
@@ -152,13 +163,13 @@ def fused_mega_model(
     # phase 1: both streams' tables read once; both bands written once;
     # the move band written once (int32) when the stats chain is on
     tab = 2 * 5 * n_steps * CB * Npad * _F32
-    band_w = 2 * K * T1p * Npad * _F32
+    band_w = 2 * K * T1p * Npad * band_itemsize
     moves = K * T1p * Npad * _F32 if want_stats else 0.0
     # phase 2: A read back once; B read back through the rolled window
     # ((C + 2 + spread) columns per C output columns); forward tables
     # re-read; dense tiles out; moves read back + stats tiles out
-    a_r = K * T1p * Npad * _F32
-    b_r = n_steps * (C + 2 + spread) * K * Npad * _F32
+    a_r = K * T1p * Npad * band_itemsize
+    b_r = n_steps * (C + 2 + spread) * K * Npad * band_itemsize
     tab2 = 5 * n_steps * CB * Npad * _F32
     tiles = T1p * 16 * Npad * _F32
     total = tab + band_w + moves + a_r + b_r + tab2 + tiles
@@ -203,6 +214,7 @@ def mesh_fused_model(
     n_devices: int,
     want_stats: bool = False,
     impl: str = "mega",
+    band_itemsize: int = _F32,
 ) -> Dict[str, float]:
     """One fused step sharded over ``n_devices`` chips: per-device HBM
     bytes at the LOCAL lane count plus the ICI collective term, against
@@ -212,11 +224,12 @@ def mesh_fused_model(
     divided by ``n_devices`` (1.0 = perfectly linear; the ICI term and
     any lane re-padding are what pull it below)."""
     per_model = fused_mega_model if impl == "mega" else fused_model
-    per = per_model(T1p, K, Npad_local, C, want_stats=want_stats)
+    per = per_model(T1p, K, Npad_local, C, want_stats=want_stats,
+                    band_itemsize=band_itemsize)
     ici = ici_collective_bytes(T1p, n_devices, want_stats=want_stats)
     t_dev = per["bytes"] / (HBM_GBPS * 1e9) + ici / (ICI_GBPS * 1e9)
     one = per_model(T1p, K, Npad_local * n_devices, C,
-                    want_stats=want_stats)
+                    want_stats=want_stats, band_itemsize=band_itemsize)
     t_one = one["bytes"] / (HBM_GBPS * 1e9)
     speedup = t_one / t_dev if t_dev > 0 else float(n_devices)
     return {

@@ -1,0 +1,251 @@
+"""Bandwidth growth policies (engine.bandgrowth): the blunt doubling
+port and the WFA-style adaptive policy, plus their integration with the
+sweep planner's heterogeneous-K re-bucketing."""
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.engine.bandgrowth import (
+    ADAPTIVE_ENTRY_BW,
+    MAX_BANDWIDTH_DOUBLINGS,
+    adaptive_entry,
+    check_band_growth,
+    grow_bandwidths,
+)
+
+BIG = np.iinfo(np.int64).max
+
+
+def _args(n, bw=8, entry=8, thr=0, old=BIG, tlen=10_000, slen=10_000):
+    """Broadcast helper: everything flagged for growth by default."""
+    return dict(
+        bandwidths=np.full(n, bw, np.int64),
+        fixed=np.zeros(n, bool),
+        old_errors=np.full(n, old, np.int64),
+        n_errors=np.full(n, 5, np.int64),
+        thresholds=np.full(n, thr, np.int64),
+        entry_bw=np.full(n, entry, np.int64),
+        tlen=tlen,
+        slen=slen,
+    )
+
+
+def test_check_band_growth():
+    assert check_band_growth("double") == "double"
+    assert check_band_growth("adaptive") == "adaptive"
+    with pytest.raises(ValueError, match="band_growth"):
+        check_band_growth("triple")
+
+
+def test_adaptive_entry_caps_only_large_bandwidths():
+    bw = np.array([4, 16, 17, 100])
+    assert adaptive_entry(bw).tolist() == [4, 16, 16, 16]
+    assert adaptive_entry(bw).dtype == bw.dtype
+
+
+def test_double_grows_flagged_reads_x2():
+    a = _args(3, bw=8)
+    new_bw, new_fixed, new_old = grow_bandwidths(**a)
+    assert new_bw.tolist() == [16, 16, 16]
+    assert not new_fixed.any()
+    assert new_old.tolist() == [5, 5, 5]
+    # inputs untouched (fresh arrays)
+    assert a["bandwidths"].tolist() == [8, 8, 8]
+
+
+def test_double_caps_at_entry_shifted_by_max_doublings():
+    """The growth ceiling is entry_bw << MAX_BANDWIDTH_DOUBLINGS —
+    keyed on the ORIGINAL entry bandwidth, never the current one."""
+    cap = 8 << MAX_BANDWIDTH_DOUBLINGS
+    a = _args(1, bw=cap // 2, entry=8)
+    new_bw, new_fixed, _ = grow_bandwidths(**a)
+    assert new_bw[0] == cap
+    # at the cap the read cannot be flagged again: it fixes
+    a = _args(1, bw=cap, entry=8)
+    new_bw, new_fixed, _ = grow_bandwidths(**a)
+    assert new_bw[0] == cap
+    assert new_fixed[0]
+
+
+def test_cap_also_bounded_by_template_and_read_length():
+    a = _args(1, bw=8, tlen=12, slen=10_000)
+    new_bw, _, _ = grow_bandwidths(**a)
+    assert new_bw[0] == 12
+    a = _args(1, bw=8, tlen=10_000, slen=9)
+    new_bw, _, _ = grow_bandwidths(**a)
+    assert new_bw[0] == 9
+
+
+def test_no_growth_on_converged_reads():
+    """A read under threshold, or no longer improving, or already
+    fixed, keeps its bandwidth and fixes."""
+    a = _args(3, bw=8, thr=10)  # n_errors=5 <= 10: under threshold
+    new_bw, new_fixed, _ = grow_bandwidths(**a)
+    assert new_bw.tolist() == [8, 8, 8]
+    assert new_fixed.all()
+
+    a = _args(1, bw=8)
+    a["old_errors"] = np.array([5])  # not improving (5 !< 5)
+    new_bw, new_fixed, _ = grow_bandwidths(**a)
+    assert new_bw[0] == 8 and new_fixed[0]
+
+    a = _args(1, bw=8)
+    a["fixed"] = np.array([True])
+    new_bw, new_fixed, _ = grow_bandwidths(**a)
+    assert new_bw[0] == 8 and new_fixed[0]
+
+
+def test_adaptive_requires_edge_hits():
+    with pytest.raises(ValueError, match="edge_hits"):
+        grow_bandwidths(**_args(1), band_growth="adaptive")
+
+
+def test_adaptive_touches_only_frontier_flagged_reads():
+    """Three flagged reads: one rides the band wall hard, one grazes
+    it, one never touches it. Only the wall-riders grow; the
+    error-bound read fixes immediately (more band cannot change its
+    alignment)."""
+    a = _args(3, bw=32)
+    new_bw, new_fixed, new_old = grow_bandwidths(
+        **a, band_growth="adaptive", edge_hits=np.array([100, 3, 0]))
+    # deficit = bucket8(max((eh+1)//2, 1)), never beyond x2 (= +bw)
+    assert new_bw.tolist() == [32 + 32, 32 + 8, 32]
+    assert new_fixed.tolist() == [False, False, True]
+    # old_errors only advances for the reads that grew
+    assert new_old.tolist() == [5, 5, BIG]
+
+
+def test_adaptive_growth_rounds_to_8_grid():
+    a = _args(4, bw=64)
+    eh = np.array([1, 15, 16, 17])
+    new_bw, _, _ = grow_bandwidths(
+        **a, band_growth="adaptive", edge_hits=eh)
+    # (eh+1)//2 -> 1, 8, 8, 9 -> bucket8 -> 8, 8, 8, 16
+    assert (new_bw - 64).tolist() == [8, 8, 8, 16]
+
+
+def test_adaptive_never_exceeds_doubling():
+    a = _args(1, bw=8)
+    new_bw, _, _ = grow_bandwidths(
+        **a, band_growth="adaptive", edge_hits=np.array([10_000]))
+    assert new_bw[0] == 16  # min(bw, deficit) = bw -> x2
+
+
+def test_adaptive_respects_same_cap_as_double():
+    cap = 8 << MAX_BANDWIDTH_DOUBLINGS
+    a = _args(1, bw=cap, entry=8)
+    new_bw, new_fixed, _ = grow_bandwidths(
+        **a, band_growth="adaptive", edge_hits=np.array([50]))
+    assert new_bw[0] == cap and new_fixed[0]
+
+
+def test_policies_ride_2d_cluster_matrices():
+    """The sweep executor calls the same function on [G, N] arrays with
+    a broadcast [G, 1] template-length column."""
+    G, N = 2, 3
+    bw = np.full((G, N), 8, np.int64)
+    out = grow_bandwidths(
+        bw, np.zeros((G, N), bool), np.full((G, N), BIG, np.int64),
+        np.full((G, N), 5, np.int64), np.zeros((G, N), np.int64),
+        bw, np.array([[100], [12]]), np.full((G, N), 10_000, np.int64),
+        band_growth="adaptive", edge_hits=np.full((G, N), 9, np.int64),
+    )
+    assert out[0].shape == (G, N)
+    assert out[0].tolist() == [[16, 16, 16], [12, 12, 12]]
+
+
+def test_fixed_all_matches_legacy_not_grow_any():
+    """The loops break on new_fixed.all(); that must coincide with the
+    legacy `not grow.any()` — every non-growing read fixes."""
+    a = _args(4, bw=8, thr=10)
+    a["n_errors"] = np.array([5, 50, 5, 50])  # two flagged, two under
+    new_bw, new_fixed, _ = grow_bandwidths(**a)
+    assert new_fixed.tolist() == [True, False, True, False]
+    assert (new_bw != a["bandwidths"]).any() == (~new_fixed).any()
+
+
+# ---- planner integration: deterministic re-bucketing ----
+
+
+def test_plan_sweep_rebuckets_on_adaptive_entry():
+    """Adaptive entry lowers per-read bands to min(bw, 16), so a
+    cluster whose caller default was huge lands in a SMALL band bucket
+    — deterministically (same inputs, same plan)."""
+    pytest.importorskip("jax")
+    from rifraf_tpu.models.errormodel import Scores
+    from rifraf_tpu.models.sequences import make_read_scores
+    from rifraf_tpu.parallel.sweep_sharded import (
+        _cluster_infos,
+        plan_sweep,
+    )
+
+    rng = np.random.default_rng(0)
+    sc = Scores(mismatch=-1.0, insertion=-2.0, deletion=-2.0)
+
+    def cluster(bw):
+        return [
+            make_read_scores(
+                rng.integers(0, 4, 80).astype(np.int8),
+                np.full(80, -1.2), bw, sc)
+            for _ in range(4)
+        ]
+
+    clusters = [cluster(64), cluster(64)]
+    info_d = _cluster_infos(clusters, "double")
+    info_a = _cluster_infos(clusters, "adaptive")
+    assert all(i.entry_k > j.entry_k for i, j in zip(info_d, info_a))
+    # entry_k from the lowered bands: 2*16 + |len-tlen0| + 1
+    assert info_a[0].entry_k == 2 * ADAPTIVE_ENTRY_BW + 1
+
+    plans_a1 = plan_sweep(clusters, band_growth="adaptive")
+    plans_a2 = plan_sweep(clusters, band_growth="adaptive")
+    assert plans_a1 == plans_a2  # deterministic
+    plans_d = plan_sweep(clusters, band_growth="double")
+    k_a = min(p.key[3] for p in plans_a1)
+    k_d = min(p.key[3] for p in plans_d)
+    assert k_a < k_d
+
+
+# ---- engine integration: both policies reach the same consensus ----
+
+
+@pytest.mark.slow
+def test_sweep_adaptive_matches_double_consensus():
+    """sweep_clusters_sharded under band_growth="adaptive" must return
+    the same consensus sequences as "double", with settled bandwidth
+    mass at-or-below doubling's (the whole point of the policy)."""
+    pytest.importorskip("jax")
+    from rifraf_tpu.models.errormodel import Scores
+    from rifraf_tpu.models.sequences import make_read_scores
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+
+    rng = np.random.default_rng(1)
+    sc = Scores(mismatch=-1.0, insertion=-2.0, deletion=-2.0)
+
+    def cluster(tlen, n, bw=32):
+        tmpl = rng.integers(0, 4, tlen).astype(np.int8)
+        reads = []
+        for _ in range(n):
+            seq = tmpl.copy()
+            for _ in range(max(1, tlen // 40)):
+                i = rng.integers(0, len(seq))
+                seq[i] = (seq[i] + 1) % 4
+            reads.append(make_read_scores(
+                seq, np.full(len(seq), -1.2), bw, sc))
+        return reads
+
+    clusters = [cluster(96, 5), cluster(64, 3), cluster(128, 6)]
+    out = {}
+    hist = {}
+    for bg in ("double", "adaptive"):
+        res, st = sweep_clusters_sharded(
+            clusters, return_stats=True, band_growth=bg)
+        out[bg] = [r.consensus.tolist() for r in res]
+        assert st.band_growth == bg
+        hist[bg] = dict(st.bw_hist)
+    assert out["adaptive"] == out["double"]
+
+    def mean_bw(h):
+        return sum(b * c for b, c in h.items()) / sum(h.values())
+
+    assert mean_bw(hist["adaptive"]) <= mean_bw(hist["double"])

@@ -1,0 +1,178 @@
+"""Mixed-precision band store (band_dtype) oracle harness.
+
+``band_dtype="f32"`` must be BIT-identical to the pre-option code: the
+f32 path has no casts, so every output — band tables, packed scores,
+consensus — compares with assert_array_equal.
+
+``band_dtype="bf16"`` stores the materialized forward/backward band
+tables in bfloat16 while every max-plus accumulation, rescoring, and
+convergence decision stays float32 (store-narrow / accumulate-wide).
+Tolerance gates here are LOG10-scaled (``assert_close(atol_log10=)``):
+band values are log10 probabilities, so an absolute tolerance in log
+space is a relative tolerance on probability. bf16 keeps ~8 mantissa
+bits (relative step 2^-8), so a table value x carries absolute error
+up to ~|x|/256 — the gates below allow that plus slack, and the
+ACCURACY gate requires the end-to-end consensus to match f32 exactly
+on well-conditioned clusters (the precision loss must shave HBM bytes,
+not bases).
+
+The CI kernels matrix runs this file once per band dtype by exporting
+``RIFRAF_TPU_BAND_DTYPE`` — unset, both parametrizations run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = pytest.importorskip("jax.numpy")
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import batch_reads, make_read_scores
+from rifraf_tpu.ops import align_jax
+from rifraf_tpu.ops.fused import fused_step_full
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+
+_ENV_DTYPE = os.environ.get("RIFRAF_TPU_BAND_DTYPE", "")
+BAND_DTYPES = [_ENV_DTYPE] if _ENV_DTYPE else ["f32", "bf16"]
+
+
+def assert_close(got, want, atol_log10=-6.0, what="values"):
+    """Compare two log10-space arrays: identical ±inf masks, finite
+    entries within ``10**atol_log10`` absolute (= relative in
+    probability space). The f32 oracle gates at atol_log10=-6 by
+    default; bf16 comparisons pass a looser bound derived from the
+    table magnitudes."""
+    got = np.asarray(got, np.float64)
+    want = np.asarray(want, np.float64)
+    assert got.shape == want.shape
+    fin_g, fin_w = np.isfinite(got), np.isfinite(want)
+    mismatched = fin_g != fin_w
+    assert not mismatched.any(), (
+        f"{what}: {mismatched.sum()} entries differ in finiteness"
+    )
+    if fin_w.any():
+        err = np.abs(got[fin_w] - want[fin_w]).max()
+        assert err <= 10.0 ** atol_log10, (
+            f"{what}: max |diff| {err:.3e} > 1e{atol_log10:g}"
+        )
+
+
+def _problem(tlen=48, n_reads=5, bw=8, seed=7):
+    rng = np.random.default_rng(seed)
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n_reads):
+        seq = template.copy()
+        for _ in range(2):
+            i = rng.integers(0, len(seq))
+            seq[i] = (seq[i] + 1) % 4
+        log_p = rng.uniform(-3.0, -1.0, size=len(seq))
+        reads.append(make_read_scores(seq, log_p, bw, SCORES))
+    return template, batch_reads(reads, dtype=np.float32)
+
+
+def _run(band_dtype, tlen=48, seed=7, K=48):
+    template, batch = _problem(tlen=tlen, seed=seed)
+    geom = align_jax.batch_geometry(batch, tlen)
+    w = jnp.ones(batch.seq.shape[0], jnp.float32)
+    A, B, moves, packed = fused_step_full(
+        jnp.asarray(template), batch.seq, batch.match, batch.mismatch,
+        batch.ins, batch.dels, geom, w, K, band_dtype=band_dtype,
+    )
+    return (np.asarray(A), np.asarray(B), np.asarray(moves),
+            np.asarray(packed))
+
+
+def test_f32_band_dtype_is_bit_identical_to_default():
+    """band_dtype="f32" inserts NO casts: every output of the fused
+    step is bitwise equal to a call that never mentions the option."""
+    base = _run("f32")
+    template, batch = _problem()
+    geom = align_jax.batch_geometry(batch, 48)
+    w = jnp.ones(batch.seq.shape[0], jnp.float32)
+    ref = fused_step_full(
+        jnp.asarray(template), batch.seq, batch.match, batch.mismatch,
+        batch.ins, batch.dels, geom, w, 48,
+    )
+    for got, want in zip(base, ref):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("band_dtype", BAND_DTYPES)
+def test_band_tables_within_tolerance_of_f32(band_dtype):
+    """The returned (re-widened) band tables stay within the dtype's
+    log10-space tolerance of the f32 oracle. For f32 that tolerance is
+    exact; for bf16 it is |x|/256 — tables here reach magnitude ~1e2,
+    so the gate sits at 10**0 with the measured error far below."""
+    ref = _run("f32")
+    got = _run(band_dtype)
+    atol = -6.0 if band_dtype == "f32" else 0.0
+    assert_close(got[0], ref[0], atol_log10=atol, what="A bands")
+    assert_close(got[1], ref[1], atol_log10=atol, what="B bands")
+    if band_dtype == "bf16":
+        # the cast is REAL: values must differ from f32 somewhere
+        fin = np.isfinite(ref[0]) & np.isfinite(got[0])
+        assert (got[0][fin] != ref[0][fin]).any()
+
+
+@pytest.mark.parametrize("band_dtype", BAND_DTYPES)
+def test_consensus_accuracy_gate(band_dtype):
+    """End-to-end accuracy gate: the driver at either band dtype must
+    recover the planted template exactly on a well-conditioned cluster
+    — bf16 trades table precision for bytes, never for bases."""
+    from rifraf_tpu.engine.driver import rifraf
+    from rifraf_tpu.engine.params import RifrafParams
+
+    rng = np.random.default_rng(11)
+    template = rng.integers(0, 4, 60).astype(np.int8)
+    seqs, lps = [], []
+    for _ in range(8):
+        seq = template.copy()
+        i = rng.integers(0, len(seq))
+        seq[i] = (seq[i] + 1) % 4
+        seqs.append(seq)
+        lps.append(np.full(len(seq), -1.5))
+    result = rifraf(
+        seqs, error_log_ps=lps,
+        params=RifrafParams(band_dtype=band_dtype),
+    )
+    assert result.consensus.tolist() == template.tolist()
+
+
+@pytest.mark.parametrize("band_dtype", BAND_DTYPES)
+def test_driver_band_dtype_consensus_matches_f32(band_dtype):
+    """Same cluster, both precisions: identical consensus (scores may
+    differ in the bf16 rounding tail)."""
+    from rifraf_tpu.engine.driver import rifraf
+    from rifraf_tpu.engine.params import RifrafParams
+
+    rng = np.random.default_rng(5)
+    template = rng.integers(0, 4, 80).astype(np.int8)
+    seqs, lps = [], []
+    for _ in range(6):
+        seq = template.copy()
+        for _ in range(2):
+            i = rng.integers(0, len(seq))
+            seq[i] = (seq[i] + 1) % 4
+        seqs.append(seq)
+        lps.append(np.full(len(seq), -1.2))
+
+    def consensus(bd):
+        return rifraf(
+            seqs, error_log_ps=lps,
+            params=RifrafParams(band_dtype=bd),
+        ).consensus.tolist()
+
+    assert consensus(band_dtype) == consensus("f32")
+
+
+def test_params_reject_unknown_band_dtype():
+    from rifraf_tpu.engine.params import RifrafParams, check_params
+
+    with pytest.raises(ValueError, match="band_dtype"):
+        check_params(SCORES, 60, RifrafParams(band_dtype="f16"))
+    with pytest.raises(ValueError, match="band_growth"):
+        check_params(SCORES, 60, RifrafParams(band_growth="wfa"))

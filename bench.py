@@ -825,12 +825,18 @@ def _serve_mode():
         ]
         health = server.health()
         csnap = server.snapshot()
+        server_stats_integrity = server.stats.integrity()
     finally:
         server.close()
     n_ok = sum(r.ok for r in chaos_responses)
     out["chaos"] = {
         "n_requests": n_chaos,
+        # the ACTIVE fault-plan string + integrity counters ride the
+        # BENCH line so a chaos run is reproducible from the artifact
+        # alone (replay the same spec, compare the same counters)
+        "fault_plan": faults,
         "faults": faults,
+        "integrity_counters": server_stats_integrity,
         "availability": round(n_ok / n_chaos, 4),
         "all_resolved_typed": all(
             r.ok or r.error is not None for r in chaos_responses
@@ -840,7 +846,69 @@ def _serve_mode():
         "retry_ladder": health["retry_ladder"],
     }
 
-    # 5. ingestion durability: a synthetic malformed-FASTQ corpus pushed
+    # 5. result integrity under fire: the `corrupt` fault kind flips a
+    # float64 bit on fetched scores — a SILENT wrong answer that no
+    # crash supervision can see. With verify_fraction=1.0 + guard
+    # sentinels on, every corruption must be detected by shadow
+    # verification (oracle re-score on the independent fused-impl
+    # path), the oracle result must replace the bad answer (so
+    # availability stays >= 0.99 — answers are corrected, not
+    # refused), and the poisoned device must land on the quarantine
+    # scoreboard.
+    n_corrupt = max(3, n_chaos // 20)
+    int_faults = f"fetch:corrupt:n={n_corrupt}"
+    int_cfg = ServeConfig(max_wait_ms=5.0, max_batch=max_batch,
+                          mesh=mesh, faults=int_faults,
+                          guard=True, verify_fraction=1.0,
+                          quarantine_threshold=3,
+                          result_timeout_s=120.0)
+    server = ConsensusServer(int_cfg)
+    try:
+        server.warmup(chaos_clusters, batch_sizes=(1, max_batch))
+        futures = []
+        for c in chaos_clusters:
+            while True:
+                try:
+                    futures.append(server.submit(c))
+                    break
+                except QueueFullError:
+                    futures[0].result()
+            time.sleep(rng.exponential(1.0 / lam))
+        int_responses = [
+            f.result(timeout=int_cfg.result_timeout_s)
+            for f in futures
+        ]
+        ihealth = server.health()
+    finally:
+        server.close()
+    ictr = ihealth["integrity"]["counters"]
+    injected = ictr.get("injected_corrupt", 0)
+    detected = ictr.get("verify_divergence", 0)
+    n_ok = sum(r.ok for r in int_responses)
+    out["integrity"] = {
+        "n_requests": n_chaos,
+        "fault_plan": int_faults,
+        "verify_fraction": 1.0,
+        "injected_corruptions": injected,
+        "detected_divergences": detected,
+        # the acceptance bar: 100% of injected corruptions detected
+        "detection_rate": (round(detected / injected, 4)
+                           if injected else None),
+        "recovered": ictr.get("verify_recovered", 0),
+        "availability": round(n_ok / n_chaos, 4),
+        "device_quarantined": ictr.get("device_quarantined", 0) >= 1,
+        "devices": ihealth["integrity"]["devices"],
+        "counters": ictr,
+        # every served answer — including the corrected ones — must
+        # still equal the offline sweep bit-for-bit
+        "results_match_offline": all(
+            np.array_equal(r.consensus, o.consensus)
+            and r.score == o.score
+            for r, o in zip(int_responses, offline[:n_chaos])
+        ),
+    }
+
+    # 6. ingestion durability: a synthetic malformed-FASTQ corpus pushed
     # through the io.stream front door under injected ingest faults —
     # the process must survive with every bad record quarantined with a
     # typed reason (the crash-safe ingestion acceptance bar), and the

@@ -145,6 +145,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fault-injection spec (serve/faults.py grammar, "
                         "e.g. 'dispatch:error:n=2'); overrides the "
                         "RIFRAF_TPU_FAULTS env var")
+    p.add_argument("--guard", action="store_true",
+                   help="on-device numerical sentinels: flag NaN/Inf/"
+                        "sentinel-underflow in band tables and scores "
+                        "per launch (result-integrity layer)")
+    p.add_argument("--verify-fraction", type=float, default=0.0,
+                   help="shadow-verify this fraction of completed "
+                        "results (deterministic content-digest sample) "
+                        "on the independent oracle path; a divergence "
+                        "is counted, quarantines the device, and the "
+                        "oracle result replaces the bad answer")
+    p.add_argument("--quarantine-threshold", type=int, default=2,
+                   help="integrity trips (guard + divergence) per "
+                        "device before it is evicted from the "
+                        "round-robin pending a clean golden probe "
+                        "(0 disables eviction)")
     p.add_argument("--stats", action="store_true",
                    help="print the metrics snapshot (including the "
                         "supervision health block) as JSON to stderr "
@@ -163,6 +178,9 @@ def config_from_args(args) -> ServeConfig:
         n_workers=max(1, args.workers),
         band_dtype=args.band_dtype,
         band_growth=args.band_growth,
+        guard=args.guard,
+        verify_fraction=args.verify_fraction,
+        quarantine_threshold=args.quarantine_threshold,
     )
     if args.seq_errors:
         kw["scores"] = parse_error_model(args.seq_errors)

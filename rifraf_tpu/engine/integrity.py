@@ -1,0 +1,328 @@
+"""Result-integrity primitives: numerical sentinels, shadow-verify
+sampling, and the divergence tolerance shared by sweep and serve.
+
+Three silent-wrong-answer classes threaten a consensus fleet (PAPERS.md:
+gpuPairHMM treats log-space Pair-HMM fidelity as a first-class
+accelerator concern; Endeavor targets the genome-scale fleets where
+silent corruption dominates):
+
+1. **Numerical escapes** — NaN/+Inf/underflow inside the band tables or
+   scores. The ``want_guard=`` reduction in ``ops.fused`` flags these
+   per read ON DEVICE (one extra lane-wise reduction in the same
+   launch); :func:`check_guard` decodes the fetched flags into a typed
+   :class:`NumericalIntegrityError` naming the stage and read lane.
+2. **Wrong-but-plausible results** — a bit-flipped fetch or a flaky
+   chip returns finite numbers that are simply not the answer. Shadow
+   verification re-scores a deterministic sample of completed results
+   (:func:`selected_for_verify`) on the independent oracle path
+   (``RIFRAF_TPU_FUSED_IMPL=split``, the 3-launch XLA-scan route) and
+   compares within :func:`score_tolerance` — the same log10-space bound
+   ``tests/test_precision.py`` gates kernels with. Disagreement raises
+   :class:`ResultDivergenceError`.
+3. **Suspect devices** — repeated trips from one chip. ``serve``'s
+   DeviceScoreboard consumes these exceptions' ``device`` attribution.
+
+All knobs default OFF: the f32 default path with integrity disabled is
+bit-identical to the unguarded code (the guard section is absent from
+``pack_layout``, not zero-filled).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import threading
+
+import numpy as np
+
+from ..ops.fused import (  # re-exported: the canonical bit definitions
+    GUARD_NAN,
+    GUARD_POSINF,
+    GUARD_UNDERFLOW,
+)
+
+__all__ = [
+    "GUARD_NAN",
+    "GUARD_POSINF",
+    "GUARD_UNDERFLOW",
+    "IntegrityError",
+    "NumericalIntegrityError",
+    "ResultDivergenceError",
+    "decode_guard",
+    "check_guard",
+    "check_finite",
+    "selected_for_verify",
+    "score_tolerance",
+    "scores_diverge",
+    "alternate_impl",
+    "oracle_impl",
+    "oracle_rescore",
+    "verify_result",
+]
+
+
+class IntegrityError(RuntimeError):
+    """Base for result-integrity failures. ``code`` is a stable
+    machine-readable identifier (the convention of engine.validate and
+    serve.errors); ``device`` (when known) attributes the failure to a
+    chip for the quarantine scoreboard."""
+
+    code = "integrity"
+
+    def __init__(self, message: str, *, device=None, **context):
+        super().__init__(message)
+        self.device = device
+        self.context = dict(context)
+
+
+class NumericalIntegrityError(IntegrityError):
+    """A guard reduction tripped: NaN/+Inf/sentinel-underflow in the
+    band tables, scores, or dense total of one launch. ``stage`` names
+    the launch ("adapt", "stage", "score", ...); ``lane`` is the first
+    offending read lane (-1 = not lane-attributable, e.g. the dense
+    total); ``flags`` is the decoded bit list."""
+
+    code = "numerical_integrity"
+
+    def __init__(self, stage: str, lane: int, flags, *, device=None,
+                 **context):
+        names = decode_guard(flags) if isinstance(flags, int) else flags
+        where = f"read lane {lane}" if lane >= 0 else "dense total"
+        super().__init__(
+            f"numerical sentinel tripped at stage {stage!r} ({where}): "
+            f"{'|'.join(names) or 'none'}",
+            device=device, stage=stage, lane=lane, flags=list(names),
+            **context,
+        )
+        self.stage = stage
+        self.lane = lane
+        self.flags = list(names)
+
+
+class ResultDivergenceError(IntegrityError):
+    """Shadow verification disagreed with the primary result beyond the
+    precision-harness tolerance: the primary answer is not trustworthy.
+    ``got``/``want`` are the primary/oracle scores; ``what`` names the
+    request or cluster."""
+
+    code = "result_divergence"
+
+    def __init__(self, what: str, got, want, tol, *, device=None,
+                 detail="", **context):
+        msg = (
+            f"shadow verification diverged for {what}: primary score "
+            f"{got!r} vs oracle {want!r} (tol {tol:g})"
+        )
+        if detail:
+            msg += f" — {detail}"
+        super().__init__(
+            msg, device=device, what=what, got=got, want=want, tol=tol,
+            **context,
+        )
+        self.what = what
+        self.got = got
+        self.want = want
+        self.tol = tol
+
+
+_GUARD_NAMES = (
+    (GUARD_NAN, "nan"),
+    (GUARD_POSINF, "posinf"),
+    (GUARD_UNDERFLOW, "underflow"),
+)
+
+
+def decode_guard(flags: int):
+    """Bitmask -> tuple of human-readable flag names."""
+    return tuple(name for bit, name in _GUARD_NAMES if int(flags) & bit)
+
+
+def check_guard(guard, stage: str, *, device=None, lane_map=None):
+    """Validate a fetched ``guard`` section (``pack_layout``'s trailing
+    ``n_reads + 1`` words: per-read flags then the dense-total flag).
+    Raises :class:`NumericalIntegrityError` on the first trip, naming
+    the stage and offending lane. ``lane_map`` (optional sequence)
+    translates a packed lane index back to a caller-side id (e.g. the
+    request a segment lane belongs to) recorded in ``context``."""
+    g = np.asarray(guard)
+    # a corrupted flag word is itself a trip: treat non-finite as NaN-bit
+    bad = ~np.isfinite(g)
+    gi = np.where(bad, GUARD_NAN, np.nan_to_num(g)).astype(np.int64)
+    hits = np.flatnonzero(gi)
+    if hits.size == 0:
+        return
+    i = int(hits[0])
+    lane = i if i < g.size - 1 else -1
+    ctx = {}
+    if lane >= 0 and lane_map is not None and lane < len(lane_map):
+        ctx["owner"] = lane_map[lane]
+    raise NumericalIntegrityError(
+        stage, lane, int(gi[i]), device=device, n_tripped=int(hits.size),
+        **ctx,
+    )
+
+
+def check_finite(values, stage: str, *, device=None, what="values"):
+    """Host-side sentinel for values that already crossed the fence
+    (fetched totals/scores): any NaN or +Inf raises
+    :class:`NumericalIntegrityError`. -Inf is legal (the empty/padded
+    score sentinel)."""
+    v = np.asarray(values, np.float64).reshape(-1)
+    bad = np.isnan(v) | np.isposinf(v)
+    hits = np.flatnonzero(bad)
+    if hits.size == 0:
+        return
+    i = int(hits[0])
+    flags = GUARD_NAN if np.isnan(v[i]) else GUARD_POSINF
+    raise NumericalIntegrityError(
+        stage, i if v.size > 1 else -1, int(flags), device=device,
+        what=what, n_tripped=int(hits.size),
+    )
+
+
+def selected_for_verify(digest: str, verify_fraction: float) -> bool:
+    """Deterministic digest-keyed sampling: the SAME results are
+    shadow-verified on every run/replica for a given fraction —
+    reproducible from the journal alone, no RNG state. ``digest`` is
+    any stable per-result key (serve request key, sweep content
+    digest)."""
+    if verify_fraction <= 0.0:
+        return False
+    if verify_fraction >= 1.0:
+        return True
+    h = hashlib.sha256(digest.encode("utf-8")).digest()
+    # first 8 bytes -> uniform in [0, 1)
+    u = int.from_bytes(h[:8], "big") / 2.0 ** 64
+    return u < verify_fraction
+
+
+def score_tolerance(score, band_dtype: str = "f32") -> float:
+    """Absolute log10-space tolerance for primary-vs-oracle score
+    comparison — the ``tests/test_precision.py`` bound. f32 paths gate
+    at ``1e-6`` absolute (assert_close's default ``atol_log10=-6``);
+    bf16 band stores carry ~|x|/256 absolute error per table value
+    (8 mantissa bits), so the bound scales with the score magnitude
+    exactly like the precision harness's bf16 legs."""
+    if band_dtype == "bf16":
+        mag = float(np.abs(score)) if np.isfinite(score) else 1.0
+        return max(1e-3, mag / 256.0 * 4.0)
+    return 1e-6
+
+
+def alternate_impl() -> str:
+    """The fused-step routing INDEPENDENT of the currently selected one:
+    the 3-launch split/XLA-scan oracle normally, the megakernel when the
+    session is already pinned to split. Either pair is bit-identical on
+    healthy hardware (tests/test_fused_pallas.py), so any disagreement
+    is the hardware/result, not the kernel."""
+    from ..ops.fused_pallas import fused_impl
+
+    return "mega" if fused_impl() == "split" else "split"
+
+
+# select_impl reads RIFRAF_TPU_FUSED_IMPL from the environment on every
+# call (not frozen into the trace cache), so pinning the env var around
+# a rifraf() call routes that call — and only that call — through the
+# oracle path. The lock serializes concurrent shadow verifications
+# (fleet worker threads) against each other's env mutation.
+_ORACLE_LOCK = threading.RLock()
+
+
+@contextlib.contextmanager
+def oracle_impl(impl=None):
+    """Pin the fused-step routing to the independent oracle path for the
+    duration (thread-exclusive)."""
+    impl = impl or alternate_impl()
+    with _ORACLE_LOCK:
+        old = os.environ.get("RIFRAF_TPU_FUSED_IMPL")
+        os.environ["RIFRAF_TPU_FUSED_IMPL"] = impl
+        try:
+            yield impl
+        finally:
+            if old is None:
+                os.environ.pop("RIFRAF_TPU_FUSED_IMPL", None)
+            else:
+                os.environ["RIFRAF_TPU_FUSED_IMPL"] = old
+
+
+def oracle_rescore(cluster, *, max_iters: int = 100, min_dist: int = 15,
+                   bandwidth_pvalue: float = 0.1,
+                   do_alignment_proposals: bool = False,
+                   band_dtype: str = "f32", band_growth: str = "double",
+                   scores=None, bandwidth=None, device=None, impl=None):
+    """Recompute one cluster's consensus on the independent oracle path:
+    the per-cluster device loop in the batched path's exact algorithmic
+    configuration (the sweep-vs-driver equality contract,
+    tests/test_sweep_sharded.py), routed through :func:`oracle_impl` and
+    optionally pinned to a DIFFERENT device. Returns the RifrafResult."""
+    import jax
+
+    from .driver import rifraf
+    from .params import RifrafParams
+
+    # scores/bandwidth: rifraf() re-derives ReadScores from the raw
+    # seq/error_log_p, so the oracle must use the SAME values the
+    # cluster was encoded with (the fallback-path contract) or the
+    # recomputation diverges for the wrong reason. None = the
+    # RifrafParams defaults, matching sweep callers.
+    extra = {}
+    if scores is not None:
+        extra["scores"] = scores
+    if bandwidth is not None:
+        extra["bandwidth"] = bandwidth
+    params = RifrafParams(
+        batch_size=0, batch_fixed=False,
+        do_alignment_proposals=do_alignment_proposals,
+        max_iters=max_iters, min_dist=min_dist,
+        bandwidth_pvalue=bandwidth_pvalue, device_loop="on",
+        band_dtype=band_dtype, band_growth=band_growth,
+        **extra,
+    )
+    with oracle_impl(impl):
+        ctx = (jax.default_device(device) if device is not None
+               else contextlib.nullcontext())
+        with ctx:
+            return rifraf(
+                [r.seq for r in cluster],
+                error_log_ps=[r.error_log_p for r in cluster],
+                params=params,
+            )
+
+
+def verify_result(cluster, got_consensus, got_score, *, what: str,
+                  band_dtype: str = "f32", device=None, impl=None,
+                  suspect_device=None, **oracle_params):
+    """Shadow-verify one completed result: oracle-rescore the cluster
+    and raise :class:`ResultDivergenceError` (attributed to
+    ``suspect_device``, the device that PRODUCED the primary result) if
+    the consensus differs or the score disagrees beyond the precision
+    bound. Returns the oracle RifrafResult — the trustworthy answer the
+    caller can substitute for the diverged one."""
+    res = oracle_rescore(cluster, band_dtype=band_dtype, device=device,
+                         impl=impl, **oracle_params)
+    want_score = float(res.state.score)
+    diverged, tol = scores_diverge(got_score, want_score, band_dtype)
+    same_cons = np.array_equal(
+        np.asarray(got_consensus), np.asarray(res.consensus)
+    )
+    if diverged or not same_cons:
+        raise ResultDivergenceError(
+            what, float(got_score), want_score, tol,
+            device=suspect_device,
+            detail="consensus mismatch" if not same_cons else "",
+        )
+    return res
+
+
+def scores_diverge(got, want, band_dtype: str = "f32"):
+    """True + tolerance if two log10 total scores disagree beyond the
+    precision-harness bound (finiteness mismatch always diverges)."""
+    tol = score_tolerance(want, band_dtype)
+    g, w = float(got), float(want)
+    gf, wf = np.isfinite(g), np.isfinite(w)
+    if gf != wf:
+        return True, tol
+    if not wf:  # both ±inf: diverge unless identical sign
+        return (g != w), tol
+    return (abs(g - w) > tol), tol

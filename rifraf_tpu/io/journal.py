@@ -19,7 +19,16 @@ silently mixing results.
 Journal grammar (one JSON object per line)::
 
     {"kind": "header", "fingerprint": "...", ...}   # first line
-    {"kind": <record kind>, ...}                    # appended per unit
+    {"kind": <record kind>, ..., "crc": <crc32>}    # appended per unit
+
+Two corruption classes are distinguished on read: a TORN record (the
+append a crash interrupted — incomplete JSON) is expected and dropped,
+while an IN-PLACE corrupted record (complete JSON whose trailing
+``crc`` field no longer matches its body — a flipped bit, a partial
+overwrite) refuses the resume with a typed :class:`JournalError`
+naming the record index: resuming past silently-altered history would
+launder the corruption into results. Records without a ``crc`` field
+(pre-CRC journals) still read — legacy journals stay resumable.
 """
 
 from __future__ import annotations
@@ -28,14 +37,39 @@ import hashlib
 import json
 import os
 import threading
+import zlib
 from typing import List, Optional, Tuple
 
 
 class JournalError(ValueError):
     """A journal that cannot be resumed against (fingerprint mismatch,
-    header missing, unreadable)."""
+    header missing, unreadable, or an in-place corrupted record)."""
 
     code = "journal_mismatch"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` so the file's CREATION
+    (its directory entry), not just its appended bytes, survives a
+    crash immediately after open/rotate. Best-effort: platforms/
+    filesystems without directory fsync are skipped silently."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _record_crc(record: dict) -> int:
+    """CRC32 of a record's canonical serialization (the record WITHOUT
+    its ``crc`` field, serialized exactly as append writes it)."""
+    return zlib.crc32(json.dumps(record).encode())
 
 
 def fingerprint(*parts) -> str:
@@ -50,8 +84,11 @@ def fingerprint(*parts) -> str:
 
 def read_journal(path: str) -> Tuple[List[dict], bool]:
     """Load every complete record; a torn trailing line (the append the
-    crash interrupted) is dropped, not an error. Returns
-    ``(records, torn)``."""
+    crash interrupted) is dropped, not an error. A COMPLETE record
+    whose ``crc`` field does not match its body is in-place corruption
+    — that raises :class:`JournalError` naming the record index
+    (CRC-less legacy records are accepted as-is). Returns
+    ``(records, torn)`` with the ``crc`` field stripped."""
     if not os.path.exists(path):
         return [], False
     records: List[dict] = []
@@ -64,17 +101,26 @@ def read_journal(path: str) -> Tuple[List[dict], bool]:
     tail = lines.pop() if lines else b""
     if tail.strip():
         torn = True
-    for ln in lines:
+    for i, ln in enumerate(lines):
         ln = ln.strip()
         if not ln:
             continue
         try:
-            records.append(json.loads(ln))
+            rec = json.loads(ln)
         except ValueError:
             # a torn line mid-file means the bytes after it belong to a
             # different write epoch — stop trusting anything past it
             torn = True
             break
+        if isinstance(rec, dict) and "crc" in rec:
+            crc = rec.pop("crc")
+            if _record_crc(rec) != crc:
+                raise JournalError(
+                    f"{path}: record {i} failed its CRC32 check — the "
+                    "journal was corrupted in place (not a torn tail); "
+                    "refusing to trust it (delete the journal to start "
+                    "fresh)")
+        records.append(rec)
     return records, torn
 
 
@@ -91,6 +137,11 @@ class Journal:
         self._lock = threading.Lock()
         mode = "ab" if (resume and os.path.exists(path)) else "wb"
         self._fh = open(path, mode)
+        if mode == "wb":
+            # the file's directory entry must be durable too: fsync'ing
+            # appended bytes is useless if the file itself vanishes with
+            # the crash
+            _fsync_dir(path)
         if mode == "ab" and self._fh.tell() > 0:
             # the crash may have torn the final append; re-anchor at the
             # last complete line so the next record starts clean
@@ -104,7 +155,16 @@ class Journal:
             self.append(dict(header, kind="header"))
 
     def append(self, record: dict) -> None:
-        line = (json.dumps(record) + "\n").encode()
+        # trailing crc field over the record's own serialization: read
+        # back, popping "crc" and re-serializing reproduces the exact
+        # bytes (json round-trips its own output), so verify-on-read
+        # catches in-place corruption, not just torn tails
+        body = json.dumps(record)
+        crc = zlib.crc32(body.encode())
+        if body == "{}":
+            line = f'{{"crc": {crc}}}\n'.encode()
+        else:
+            line = (body[:-1] + f', "crc": {crc}}}\n').encode()
         with self._lock:
             self._fh.write(line)
             self._fh.flush()

@@ -48,6 +48,7 @@ from ..engine.validate import (
 )
 from ..utils.constants import encode_seq
 from .fastx import PHRED_OFFSET
+from .journal import _fsync_dir
 
 _RECORD_SNIPPET = 200  # bytes of the offending record kept in quarantine
 
@@ -106,6 +107,9 @@ class QuarantineWriter:
                 return
             if self._fh is None:
                 self._fh = open(self.path, "ab")
+                # the sidecar's directory entry must survive the same
+                # crash its fsync'd records are protecting against
+                _fsync_dir(self.path)
             self._fh.write((json.dumps(entry) + "\n").encode())
             self._fh.flush()
             os.fsync(self._fh.fileno())

@@ -36,6 +36,39 @@ from .proposal_dense import (
 # above it, see dense_tables_blocked)
 DENSE_BLOCK_THRESHOLD = 2048
 
+# Guard-flag bits (engine.integrity decodes these): NaN anywhere in the
+# guarded values, +Inf (never legitimate — scores are log10 probabilities,
+# padding is -Inf), and finite values below GUARD_UNDERFLOOR ("sentinel
+# underflow": a log10 score can never legitimately reach this magnitude,
+# so a finite value out here means accumulation drifted into the -Inf
+# padding sentinel's range and comparisons/maxes are no longer
+# trustworthy).
+GUARD_NAN = 1
+GUARD_POSINF = 2
+GUARD_UNDERFLOW = 4
+GUARD_UNDERFLOOR = -1e18
+
+
+def _guard_flags(*arrays):
+    """Per-read int32 guard bitmask over ``arrays`` whose leading axis is
+    the read axis: GUARD_NAN | GUARD_POSINF | GUARD_UNDERFLOW reduced over
+    every trailing axis. -Inf band padding is legal and flags nothing."""
+    flags = None
+    for x in arrays:
+        axes = tuple(range(1, x.ndim))
+        nan = jnp.any(jnp.isnan(x), axis=axes)
+        pos = jnp.any(jnp.isposinf(x), axis=axes)
+        under = jnp.any(
+            jnp.isfinite(x) & (x < GUARD_UNDERFLOOR), axis=axes
+        )
+        f = (
+            nan.astype(jnp.int32) * GUARD_NAN
+            | pos.astype(jnp.int32) * GUARD_POSINF
+            | under.astype(jnp.int32) * GUARD_UNDERFLOW
+        )
+        flags = f if flags is None else flags | f
+    return flags
+
 
 @jax.custom_batching.custom_vmap
 def _fill_barrier(ab):
@@ -66,7 +99,7 @@ def _band_narrow(A, B, band_dtype):
 def _fused_parts(
     template, seq, match, mismatch, ins, dels, geom, weights, K,
     want_moves, want_stats, want_tables=True, want_edge=False,
-    band_dtype="f32",
+    band_dtype="f32", want_guard=False,
 ):
     """The per-read-block device work: fills, dense tables, stats.
 
@@ -77,7 +110,10 @@ def _fused_parts(
     statistics, and the dense sweep is the single most expensive
     component of the step (round-4 profile). ``want_edge`` adds the
     per-read band-edge-hit counts (adaptive growth's frontier signal)
-    to the components; requires ``want_stats``."""
+    to the components; requires ``want_stats``. ``want_guard`` adds a
+    per-read guard bitmask over the fresh band tables and scores (the
+    numerical sentinel reduction — a handful of lane-wise reductions on
+    values already in registers, so the guarded step stays one launch)."""
     fwd_bwd = jax.vmap(
         align_jax._fwd_bwd_one,
         in_axes=(None, 0, 0, 0, 0, 0, 0, None, None),
@@ -117,6 +153,8 @@ def _fused_parts(
         "ins": ins_t,
         "del": del_t,
     }
+    if want_guard:
+        comp["guard"] = _guard_flags(A, B, scores[:, None])
     if want_stats:
         if want_edge:
             stats = jax.vmap(
@@ -154,18 +192,26 @@ def _pack(comp, dtype, want_stats):
         comp["ins"].reshape(-1),
         comp["del"],
     ]
+    if "guard" in comp:
+        # guard rides LAST so every pre-guard offset stays byte-identical;
+        # the extra trailing scalar guards the dense total itself
+        total_flag = _guard_flags(comp["total"][None, None])
+        parts.append(
+            jnp.concatenate([comp["guard"], total_flag]).astype(dtype)
+        )
     return jnp.concatenate(parts)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("K", "want_moves", "want_stats", "read_chunk",
-                     "want_tables", "want_edge", "band_dtype"),
+                     "want_tables", "want_edge", "band_dtype",
+                     "want_guard"),
 )
 def fused_step_full(
     template, seq, match, mismatch, ins, dels, geom, weights, K,
     want_moves=False, want_stats=False, read_chunk=0, want_tables=True,
-    want_edge=False, band_dtype="f32",
+    want_edge=False, band_dtype="f32", want_guard=False,
 ):
     """One driver iteration's full device work in one dispatch.
 
@@ -198,6 +244,7 @@ def fused_step_full(
         A, B, moves, comp = _fused_parts(
             template, seq, match, mismatch, ins, dels, geom, weights, K,
             want_moves, want_stats, want_tables, want_edge, band_dtype,
+            want_guard,
         )
         return A, B, moves, _pack(comp, match.dtype, want_stats)
 
@@ -232,7 +279,7 @@ def fused_step_full(
         _, _, moves_c, comp = _fused_parts(
             template, seq_c, match_c, mismatch_c, ins_c, dels_c, geom_c,
             w_c, K, want_moves, want_stats, want_tables, want_edge,
-            band_dtype,
+            band_dtype, want_guard,
         )
         if moves_c is None:
             moves_c = jnp.zeros((0,), jnp.int8)
@@ -253,6 +300,8 @@ def fused_step_full(
         comp["edits"] = jnp.max(comps["edits"], axis=0)
         if want_edge:
             comp["edge_hits"] = comps["edge_hits"].reshape(Np)[:N]
+    if want_guard:
+        comp["guard"] = comps["guard"].reshape(Np)[:N]
     moves = (
         moves_b.reshape((Np,) + moves_b.shape[2:])[:N] if want_moves else None
     )
@@ -309,13 +358,13 @@ def segment_union_max_lanes(seg_ids, x, n_seg: int):
 @functools.partial(
     jax.jit,
     static_argnames=("K", "n_seg", "want_stats", "want_tables",
-                     "want_edge", "band_dtype"),
+                     "want_edge", "band_dtype", "want_guard"),
 )
 def fused_step_segmented(
     templates, tlens, seg_ids, seq, match, mismatch, ins, dels,
     lengths, bandwidths, weights, K, n_seg,
     want_stats=False, want_tables=True, want_edge=False,
-    band_dtype="f32",
+    band_dtype="f32", want_guard=False,
 ):
     """The fused step for a SEGMENT-PACKED lane block: multiple
     independent problems share one ``[N]`` read block, identified by a
@@ -376,6 +425,10 @@ def fused_step_segmented(
         )(seg_w),
         "scores": scores,
     }
+    if want_guard:
+        # per-LANE flags: the executor attributes a trip to a lane, then
+        # maps the lane back to its segment/request host-side
+        out["guard"] = _guard_flags(A, B, scores[:, None])
     if want_tables:
         subs, insr, dele = _dense_batch(
             A, B, seq, match, mismatch, ins, dels, geom
@@ -414,11 +467,15 @@ def fused_step_segmented(
 
 
 def pack_layout(n_reads: int, T1: int, want_stats: bool,
-                want_tables: bool = True, want_edge: bool = False):
+                want_tables: bool = True, want_edge: bool = False,
+                want_guard: bool = False):
     """Slice map of fused_step_full's packed array: name -> (start, stop).
     ``want_edge`` (valid only with ``want_stats``) inserts the per-read
     ``edge_hits`` section after ``edits`` — absent by default, so every
-    existing layout stays byte-identical."""
+    existing layout stays byte-identical. ``want_guard`` appends the
+    ``guard`` section (n_reads per-read flag words + 1 trailing
+    dense-total flag) at the very END, so even a guarded layout leaves
+    every pre-guard offset unchanged."""
     out = {}
     o = 0
 
@@ -438,6 +495,8 @@ def pack_layout(n_reads: int, T1: int, want_stats: bool,
         take("sub", T1 * 4)
         take("ins", T1 * 4)
         take("del", T1)
+    if want_guard:
+        take("guard", n_reads + 1)
     return out
 
 

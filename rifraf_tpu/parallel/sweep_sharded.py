@@ -544,10 +544,12 @@ def plan_cells(plans: Sequence[BucketPlan]) -> int:
 
 @functools.lru_cache(maxsize=None)
 def _adapt_program(Tmax: int, K: int, want_edge: bool = False,
-                   band_dtype: str = "f32"):
+                   band_dtype: str = "f32", want_guard: bool = False):
     """One adaptive-bandwidth round for a whole chunk: vmapped fill +
     traceback statistics, n_errors [G, N] out (plus edge_hits [G, N]
-    when ``want_edge``, for the adaptive growth policy). Module-level
+    when ``want_edge``, for the adaptive growth policy; plus the
+    per-read guard flags [G, N + 1] when ``want_guard`` — the numerical
+    sentinel over the freshly filled bands and scores). Module-level
     cache so repeated sweep calls reuse the jitted wrapper (a fresh
     jax.jit per call would recompile every round of every call)."""
     import jax
@@ -561,13 +563,16 @@ def _adapt_program(Tmax: int, K: int, want_edge: bool = False,
         _, _, _, packed = fused_step_full(
             tmpl_g[:Tmax], seq_g, match_g, mismatch_g, ins_g, dels_g,
             geom, w_g, K, False, True, 0, False, want_edge, band_dtype,
+            want_guard,
         )
         lay = pack_layout(seq_g.shape[0], Tmax + 1, True, False,
-                          want_edge)
-        n_err = packed[slice(*lay["n_errors"])]
+                          want_edge, want_guard)
+        out = [packed[slice(*lay["n_errors"])]]
         if want_edge:
-            return n_err, packed[slice(*lay["edge_hits"])]
-        return n_err
+            out.append(packed[slice(*lay["edge_hits"])])
+        if want_guard:
+            out.append(packed[slice(*lay["guard"])])
+        return tuple(out)
 
     return jax.jit(jax.vmap(one))
 
@@ -617,11 +622,13 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
 
 @functools.lru_cache(maxsize=None)
 def _seg_adapt_program(Tmax: int, K: int, S: int,
-                       want_edge: bool = False, band_dtype: str = "f32"):
+                       want_edge: bool = False, band_dtype: str = "f32",
+                       want_guard: bool = False):
     """Segment-packed adaptive-bandwidth round: per-lane traceback
     error counts for a chunk of packs, each lane filled against ITS
     segment's template. Per-lane values are identical to the
-    whole-block adapt program's (the fills are independent per read)."""
+    whole-block adapt program's (the fills are independent per read).
+    ``want_guard`` appends the per-LANE guard flags [G, N]."""
     import jax
 
     from ..ops.fused import fused_step_segmented
@@ -632,11 +639,14 @@ def _seg_adapt_program(Tmax: int, K: int, S: int,
             tmpl_g, tlen_g, seg_g, seq_g, match_g, mismatch_g, ins_g,
             dels_g, lengths_g, bw_g, w_g, K, S,
             want_stats=True, want_tables=False, want_edge=want_edge,
-            band_dtype=band_dtype,
+            band_dtype=band_dtype, want_guard=want_guard,
         )
+        res = [out["n_errors"]]
         if want_edge:
-            return out["n_errors"], out["edge_hits"]
-        return out["n_errors"]
+            res.append(out["edge_hits"])
+        if want_guard:
+            res.append(out["guard"])
+        return tuple(res)
 
     return jax.jit(jax.vmap(one))
 
@@ -706,7 +716,7 @@ class ChunkExecutor:
                  bandwidth_pvalue: float = 0.1,
                  do_alignment_proposals: bool = False, device=None,
                  band_dtype: str = "f32", band_growth: str = "double",
-                 bw_sink=None):
+                 bw_sink=None, want_guard: bool = False):
         import jax
 
         from ..engine.params import resolve_dtype
@@ -734,6 +744,37 @@ class ChunkExecutor:
         # live lanes — sweep-level accounting without widening the
         # run()/collect() handle protocol the serving path relies on
         self.bw_sink = bw_sink
+        # numerical sentinels: the adapt rounds fetch the on-device
+        # guard reduction with their n_errors (NaN/+Inf/underflow per
+        # read lane -> NumericalIntegrityError), and collect() checks
+        # the fetched stage totals host-side. Off by default: the
+        # unguarded programs are byte-identical to pre-guard code.
+        self.want_guard = want_guard
+
+    def _check_guard(self, guard, stage: str, owners):
+        """Validate fetched per-chunk-row guard flags (raises
+        NumericalIntegrityError on the first trip, attributed to this
+        executor's device). ``owners[g]`` is either one owner id for
+        every lane of row ``g`` (whole-block: the row IS one cluster)
+        or a per-lane sequence (segment-packed rows)."""
+        from ..engine.integrity import check_guard
+
+        for g in range(min(guard.shape[0], len(owners))):
+            ow = owners[g]
+            lane_map = (list(ow) if isinstance(ow, (list, tuple, np.ndarray))
+                        else [ow] * guard.shape[1])
+            check_guard(guard[g], stage, device=self.device,
+                        lane_map=lane_map)
+
+    def _check_totals(self, pairs, stage: str):
+        """Host-side sentinel over fetched stage results: NaN/+Inf in a
+        final total is a numerical escape the adapt-round guard cannot
+        see (it fires inside the refine loop's launch)."""
+        from ..engine.integrity import check_finite
+
+        for ci, r in pairs:
+            check_finite([r.score], stage, device=self.device,
+                         what=f"cluster {ci} total")
 
     def _shard(self, a, *spec):
         """Device placement of one input array: sharded over the mesh
@@ -856,16 +897,16 @@ class ChunkExecutor:
                      + 1).max()),
                 plan.band,
             )
-            out = _adapt_program(Tmax, K, adaptive, self.band_dtype)(
+            out = _adapt_program(Tmax, K, adaptive, self.band_dtype,
+                                 self.want_guard)(
                 sq_d, mt_d, mm_d, gi_d, dl_d, ln_d,
                 shard(bandwidths, None), w_d, t0_d, tl_d,
             )
-            if adaptive:
-                n_err = np.asarray(out[0]).astype(np.int64)
-                edge = np.asarray(out[1]).astype(np.int64)
-            else:
-                n_err = np.asarray(out).astype(np.int64)
-                edge = None
+            n_err = np.asarray(out[0]).astype(np.int64)
+            edge = (np.asarray(out[1]).astype(np.int64) if adaptive
+                    else None)
+            if self.want_guard:
+                self._check_guard(np.asarray(out[-1]), "adapt", idxs)
             bandwidths, fixed, old_errors = grow_bandwidths(
                 bandwidths, fixed, old_errors, n_err, p["thresholds"],
                 entry_bw, tlens0[:, None], lengths,
@@ -910,6 +951,8 @@ class ChunkExecutor:
                 consensus=tmpl[:tlen], score=total, n_iters=n_rec,
                 converged=completed,
             ))
+        if self.want_guard:
+            self._check_totals(zip(idxs, results), "stage")
         return results
 
     def pack_seg(self, plan: SegmentBucketPlan, packs: Sequence[PackPlan],
@@ -1031,16 +1074,22 @@ class ChunkExecutor:
                 plan.band,
             )
             out = _seg_adapt_program(Tmax, K, S, adaptive,
-                                     self.band_dtype)(
+                                     self.band_dtype, self.want_guard)(
                 sq_d, mt_d, mm_d, gi_d, dl_d, ln_d,
                 shard(bandwidths, None), w_d, sg_d, t0_d, tl_d,
             )
-            if adaptive:
-                n_err = np.asarray(out[0]).astype(np.int64)
-                edge = np.asarray(out[1]).astype(np.int64)
-            else:
-                n_err = np.asarray(out).astype(np.int64)
-                edge = None
+            n_err = np.asarray(out[0]).astype(np.int64)
+            edge = (np.asarray(out[1]).astype(np.int64) if adaptive
+                    else None)
+            if self.want_guard:
+                # map each lane back to its segment's cluster id
+                owners = [
+                    [pk.members[s][0] if s < len(pk.members)
+                     else pk.members[0][0]
+                     for s in seg_ids[g]]
+                    for g, pk in enumerate(packs)
+                ]
+                self._check_guard(np.asarray(out[-1]), "adapt", owners)
             bandwidths, fixed, old_errors = grow_bandwidths(
                 bandwidths, fixed, old_errors, n_err, p["thresholds"],
                 entry_bw, tlen_lane, lengths,
@@ -1084,6 +1133,8 @@ class ChunkExecutor:
                     consensus=tmpl[:tlen], score=total, n_iters=n_rec,
                     converged=completed,
                 )))
+        if self.want_guard:
+            self._check_totals(out, "stage")
         return out
 
 
@@ -1108,6 +1159,8 @@ def sweep_clusters_sharded(
     resume: bool = False,
     band_dtype: str = "f32",
     band_growth: str = "double",
+    guard: bool = False,
+    verify_fraction: float = 0.0,
 ):
     """One consensus per cluster, all clusters in one device program.
 
@@ -1149,6 +1202,19 @@ def sweep_clusters_sharded(
     skips the journaled chunks, and returns results bit-identical to an
     uninterrupted run. The checkpoint interval is ONE CHUNK: at most
     one chunk per pipeline slot is recomputed.
+
+    ``guard=True`` turns on the numerical sentinels: every adapt round
+    fetches the on-device guard reduction (NaN/+Inf/sentinel-underflow
+    per read lane, engine.integrity) and every collected total is
+    host-checked — a trip raises ``NumericalIntegrityError`` naming the
+    stage and lane instead of journaling a poisoned result.
+    ``verify_fraction`` > 0 shadow-verifies that fraction of completed
+    clusters (deterministically sampled by content digest, so the same
+    clusters re-verify on every run): each sampled result is re-scored
+    on the independent oracle path (``RIFRAF_TPU_FUSED_IMPL`` flipped,
+    per-cluster device loop) and a disagreement beyond the
+    tests/test_precision.py tolerance raises ``ResultDivergenceError``.
+    Both default OFF, leaving the default path bit-identical.
 
     Returns the per-cluster results IN INPUT ORDER; with
     ``return_stats`` also a SweepStats (per-bucket occupancy, padding
@@ -1200,6 +1266,7 @@ def sweep_clusters_sharded(
                 do_alignment_proposals=do_alignment_proposals,
                 band_dtype=band_dtype, band_growth=band_growth,
                 bw_sink=bw_sink if return_stats else None,
+                want_guard=guard,
             )
             for i in range(n_workers)
         ]
@@ -1210,6 +1277,7 @@ def sweep_clusters_sharded(
             do_alignment_proposals=do_alignment_proposals,
             band_dtype=band_dtype, band_growth=band_growth,
             bw_sink=bw_sink if return_stats else None,
+            want_guard=guard,
         )]
 
     tasks = [
@@ -1230,13 +1298,22 @@ def sweep_clusters_sharded(
         from ..io.journal import fingerprint, open_resumable
         from ..utils.constants import encode_seq
 
+        # integrity knobs fold in only when ACTIVE so default-path
+        # journals keep their pre-integrity fingerprints (a guard or
+        # verify setting never changes results, but resuming a guarded
+        # run unguarded would skip its checks silently)
+        integrity_parts = []
+        if guard:
+            integrity_parts += ["guard", True]
+        if verify_fraction > 0.0:
+            integrity_parts += ["verify_fraction", verify_fraction]
         fp = fingerprint(
             G, [tuple(i) for i in infos], _content_digest(clusters),
             max_iters, min_dist,
             bandwidth_pvalue, len_bucket, cluster_chunk, scheduler,
             read_bucket, band_bucket, do_alignment_proposals,
             lane_target, segment_pack, segment_align,
-            band_dtype, band_growth,
+            band_dtype, band_growth, *integrity_parts,
         )
         journal, prior = open_resumable(
             journal_path,
@@ -1339,6 +1416,30 @@ def sweep_clusters_sharded(
             th.join()
     if journal is not None:
         journal.close()
+
+    # ---- shadow verification: re-score a deterministic content-keyed
+    # sample of the completed clusters on the independent oracle path.
+    # Runs AFTER the journal closes: a diverged result has already been
+    # journaled as a chunk, but the raise below means the caller never
+    # sees (or re-emits) it as truth, and a resume re-verifies the same
+    # sample again.
+    if verify_fraction > 0.0:
+        from ..engine.integrity import selected_for_verify, verify_result
+
+        for ci in range(G):
+            if out[ci] is None:
+                continue
+            digest = _content_digest([clusters[ci]])
+            if not selected_for_verify(digest, verify_fraction):
+                continue
+            verify_result(
+                clusters[ci], out[ci].consensus, out[ci].score,
+                what=f"sweep cluster {ci}", band_dtype=band_dtype,
+                max_iters=max_iters, min_dist=min_dist,
+                bandwidth_pvalue=bandwidth_pvalue,
+                do_alignment_proposals=do_alignment_proposals,
+                band_growth=band_growth,
+            )
 
     if not return_stats:
         return list(out)

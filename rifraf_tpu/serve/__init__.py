@@ -24,12 +24,18 @@ from .errors import (
     WaitTimeoutError,
     WorkerCrashError,
 )
+from ..engine.integrity import (
+    IntegrityError,
+    NumericalIntegrityError,
+    ResultDivergenceError,
+)
 from .faults import (
     FaultPlan,
     FaultSpec,
     InjectedCrashError,
     InjectedFaultError,
 )
+from .quarantine import DeviceScoreboard
 from .request import Request, Response, ServeConfig, encode_cluster
 from .server import ConsensusServer, submit_many
 from .stats import ServerStats
@@ -38,12 +44,16 @@ from .worker import InternalError
 __all__ = [
     "ConsensusServer",
     "DeadlineExceededError",
+    "DeviceScoreboard",
     "EmptyClusterError",
     "FaultPlan",
     "FaultSpec",
     "InjectedCrashError",
     "InjectedFaultError",
+    "IntegrityError",
     "InternalError",
+    "NumericalIntegrityError",
+    "ResultDivergenceError",
     "InvalidRequestError",
     "MicroBatcher",
     "OversizeError",

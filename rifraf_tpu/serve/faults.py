@@ -30,7 +30,14 @@ Kinds:
   subclass that escapes every ``except Exception`` handler and kills
   the worker thread outright (the SIGKILL-style death the supervisor
   watchdog exists for);
-- ``delay`` sleeps ``ms`` milliseconds (stall/watchdog testing).
+- ``delay`` sleeps ``ms`` milliseconds (stall/watchdog testing);
+- ``corrupt`` raises NOTHING: the consumer polls ``plan.corrupt(site)``
+  at the fetch site and, when it answers, deterministically perturbs
+  the fetched value (``corrupt_value`` — a float64 bit flip, ``bit=``
+  selects which). The silent-wrong-answer injection the
+  result-integrity layer (shadow verification, device quarantine)
+  exists to catch: no exception, no crash, just a plausible wrong
+  number.
 
 Spec grammar (``RIFRAF_TPU_FAULTS`` env var or ``ServeConfig.faults``)::
 
@@ -42,8 +49,10 @@ Spec grammar (``RIFRAF_TPU_FAULTS`` env var or ``ServeConfig.faults``)::
              | "p=" float    fire probability (seeded Bernoulli)
              | "seed=" int   RNG seed for p (default 0)
              | "ms=" float   delay milliseconds (kind=delay)
+             | "bit=" int    float64 bit to flip (kind=corrupt,
+                             default 51 — the top mantissa bit)
 
-e.g. ``"dispatch:error:n=2;fetch:delay:ms=50;pack:crash:after=3"``.
+e.g. ``"dispatch:error:n=2;fetch:delay:ms=50;fetch:corrupt:n=3"``.
 All counting is thread-safe; ``snapshot()`` reports per-site invocation
 and per-spec fire counts for ``ConsensusServer.health()``.
 """
@@ -52,6 +61,7 @@ from __future__ import annotations
 
 import os
 import random
+import struct
 import threading
 import time
 from dataclasses import dataclass, field
@@ -61,7 +71,20 @@ ENV_VAR = "RIFRAF_TPU_FAULTS"
 
 SITES = ("ingest", "admit", "pack", "compile", "dispatch", "fetch",
          "fallback")
-KINDS = ("error", "crash", "delay")
+KINDS = ("error", "crash", "delay", "corrupt")
+
+# default corrupt bit: the float64 top mantissa bit — a large, finite,
+# sign-preserving relative error (the classic silent bit-flip)
+CORRUPT_BIT = 51
+
+
+def corrupt_value(x: float, bit: int = CORRUPT_BIT) -> float:
+    """Deterministically flip one bit of ``x``'s float64 representation.
+    The injected silent corruption: finite in, (usually) finite out,
+    numerically wrong."""
+    b = struct.unpack("<Q", struct.pack("<d", float(x)))[0]
+    b ^= 1 << (int(bit) % 64)
+    return struct.unpack("<d", struct.pack("<Q", b))[0]
 
 
 class InjectedFaultError(RuntimeError):
@@ -81,12 +104,13 @@ class FaultSpec:
     """One injection rule at one site."""
 
     site: str
-    kind: str  # "error" | "crash" | "delay"
+    kind: str  # "error" | "crash" | "delay" | "corrupt"
     n: int = 1  # max fires; 0 = unlimited
     after: int = 0  # skip the first `after` invocations of the site
     p: float = 1.0  # fire probability per eligible invocation
     seed: int = 0  # Bernoulli RNG seed (deterministic across runs)
     ms: float = 0.0  # delay milliseconds (kind="delay")
+    bit: int = CORRUPT_BIT  # float64 bit to flip (kind="corrupt")
     fired: int = 0  # mutable: how many times this spec has fired
     _rng: random.Random = field(default=None, repr=False)  # type: ignore
 
@@ -139,7 +163,7 @@ class FaultPlan:
                         raise ValueError(
                             f"fault option {opt!r} is not key=value"
                         )
-                    if k in ("n", "after", "seed"):
+                    if k in ("n", "after", "seed", "bit"):
                         kw[k] = int(v)
                     elif k in ("p", "ms"):
                         kw[k] = float(v)
@@ -166,7 +190,7 @@ class FaultPlan:
             idx = self._site_calls.get(site, 0)
             self._site_calls[site] = idx + 1
             for s in self.specs:
-                if s.site != site:
+                if s.site != site or s.kind == "corrupt":
                     continue
                 if s.n and s.fired >= s.n:
                     continue
@@ -193,6 +217,32 @@ class FaultPlan:
             time.sleep(delay_s)
         if to_raise is not None:
             raise to_raise
+
+    def corrupt(self, site: str) -> Optional[int]:
+        """The silent sibling of :meth:`fire` for ``kind="corrupt"``
+        specs: returns the bit to flip when a matching spec fires, else
+        None. Counted on a separate per-site key (``site~corrupt``) so
+        corrupt ``after=`` gating does not interact with the raising
+        kinds' invocation counts. Never raises — the whole point is
+        that the caller hands on a plausibly wrong value."""
+        if not self.specs:
+            return None
+        with self._lock:
+            key = site + "~corrupt"
+            idx = self._site_calls.get(key, 0)
+            self._site_calls[key] = idx + 1
+            for s in self.specs:
+                if s.site != site or s.kind != "corrupt":
+                    continue
+                if s.n and s.fired >= s.n:
+                    continue
+                if idx < s.after:
+                    continue
+                if s.p < 1.0 and s._rng.random() >= s.p:
+                    continue
+                s.fired += 1
+                return s.bit
+        return None
 
     # ---- observability ----
 

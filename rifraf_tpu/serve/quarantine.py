@@ -1,0 +1,165 @@
+"""Suspect-device quarantine: the per-device integrity scoreboard and
+the known-answer golden probe.
+
+A fleet you cannot trust per-device cannot be made elastic: a chip that
+flips bits returns *plausible* wrong answers, so crash supervision (PR
+7) never sees it. The integrity layer attributes every tripped sentinel
+(``NumericalIntegrityError``) and every shadow-verification divergence
+(``ResultDivergenceError``) to the device that produced the result;
+this module keeps score.
+
+Lifecycle:
+
+1. **Scoring** — ``DeviceScoreboard.record_trip(device, kind)`` counts
+   guard trips and divergences per device. Crossing
+   ``quarantine_threshold`` evicts the device from the round-robin:
+   its worker stops taking flushes (they re-queue for fleet mates) and
+   enters the probe loop.
+2. **Probing** — the golden probe (:func:`golden_problem`) is a
+   deterministic known-answer cluster: error-free reads copied from a
+   fixed planted template, so the only correct consensus IS the
+   template. The probe runs through the worker's OWN executor on its
+   OWN device; it passes iff the consensus equals the template and the
+   score is finite. Also run at warmup and after every supervisor
+   restart, so a freshly (re)started worker proves itself before
+   rejoining the round-robin.
+3. **Reinstating** — ``note_probe(device, ok=True)`` clears the
+   quarantine and zeroes the trip counters; a failing probe keeps the
+   device quarantined (and the supervisor keeps it parked instead of
+   burning restart budget on a chip that cannot pass a 48-base
+   problem).
+
+Everything is visible in ``ConsensusServer.health()["integrity"]`` and
+the ``ServerStats`` integrity counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+TRIP_KINDS = ("guard", "divergence")
+
+# golden-problem constants: a fixed 48-base planted template (length
+# divisible by the codon machinery), a handful of error-free copies, a
+# flat high-confidence error profile. Deterministic by construction —
+# no RNG state leaks into the probe.
+GOLDEN_LEN = 48
+GOLDEN_READS = 3
+GOLDEN_LOG_P = -4.0
+GOLDEN_SEED = 1729
+
+
+def device_key(device) -> str:
+    """Stable scoreboard key for a jax device (or None = host default)."""
+    return "default" if device is None else str(device)
+
+
+def golden_problem(config):
+    """Build the known-answer probe: ``(cluster, template)`` where the
+    cluster is ``GOLDEN_READS`` error-free copies of the planted
+    template encoded with the server's own scores/bandwidth (so the
+    probe exercises the same numeric path as traffic)."""
+    from ..models.sequences import make_read_scores
+
+    rng = np.random.default_rng(GOLDEN_SEED)
+    template = rng.integers(0, 4, size=GOLDEN_LEN).astype(np.int8)
+    log_p = np.full(GOLDEN_LEN, GOLDEN_LOG_P, dtype=np.float64)
+    cluster = [
+        make_read_scores(template.copy(), log_p.copy(),
+                         config.bandwidth, config.scores)
+        for _ in range(GOLDEN_READS)
+    ]
+    return cluster, template
+
+
+class _DeviceScore:
+    __slots__ = ("trips", "quarantined", "probes_pass", "probes_fail")
+
+    def __init__(self):
+        self.trips: Dict[str, int] = {k: 0 for k in TRIP_KINDS}
+        self.quarantined = False
+        self.probes_pass = 0
+        self.probes_fail = 0
+
+
+class DeviceScoreboard:
+    """Thread-safe per-device integrity accounting.
+
+    ``threshold`` is the total trip count (guard + divergence) at which
+    a device is evicted; 0 disables eviction (trips are still counted
+    and visible)."""
+
+    def __init__(self, threshold: int = 2):
+        self.threshold = int(threshold)
+        self._lock = threading.Lock()
+        self._scores: Dict[str, _DeviceScore] = {}
+
+    def _get(self, key: str) -> _DeviceScore:
+        sc = self._scores.get(key)
+        if sc is None:
+            sc = self._scores[key] = _DeviceScore()
+        return sc
+
+    def record_trip(self, device, kind: str) -> bool:
+        """Count one integrity trip against ``device``. Returns True
+        exactly when this trip crosses the threshold and quarantines
+        the device (the caller counts the eviction)."""
+        if kind not in TRIP_KINDS:
+            raise ValueError(f"unknown trip kind {kind!r}")
+        key = device_key(device)
+        with self._lock:
+            sc = self._get(key)
+            sc.trips[kind] += 1
+            total = sum(sc.trips.values())
+            if (self.threshold > 0 and not sc.quarantined
+                    and total >= self.threshold):
+                sc.quarantined = True
+                return True
+        return False
+
+    def quarantine(self, device) -> None:
+        """Explicit eviction (warmup/restart probe failure)."""
+        with self._lock:
+            self._get(device_key(device)).quarantined = True
+
+    def is_quarantined(self, device) -> bool:
+        with self._lock:
+            sc = self._scores.get(device_key(device))
+            return bool(sc is not None and sc.quarantined)
+
+    def note_probe(self, device, ok: bool) -> bool:
+        """Record a golden-probe outcome. A passing probe REINSTATES
+        the device (quarantine cleared, trip counters zeroed — it
+        starts clean); a failing one quarantines it. Returns whether
+        the device is quarantined after the probe."""
+        with self._lock:
+            sc = self._get(device_key(device))
+            if ok:
+                sc.probes_pass += 1
+                sc.quarantined = False
+                sc.trips = {k: 0 for k in TRIP_KINDS}
+            else:
+                sc.probes_fail += 1
+                sc.quarantined = True
+            return sc.quarantined
+
+    def any_quarantined(self) -> bool:
+        with self._lock:
+            return any(sc.quarantined for sc in self._scores.values())
+
+    def snapshot(self) -> dict:
+        """JSON-serializable per-device state for ``health()``."""
+        with self._lock:
+            return {
+                key: {
+                    "quarantined": sc.quarantined,
+                    "guard_trips": sc.trips["guard"],
+                    "divergences": sc.trips["divergence"],
+                    "probes_pass": sc.probes_pass,
+                    "probes_fail": sc.probes_fail,
+                }
+                for key, sc in self._scores.items()
+            }

@@ -99,6 +99,30 @@ class ServeConfig:
     # (segment-packed -> whole-block batch -> per-request fallback); 2
     # covers the full descent
     max_retries: int = 2
+    # --- result integrity (all OFF by default: the f32 default path
+    # with integrity off is bit-identical to the unguarded code) ---
+    # on-device numerical sentinels: the executor requests the
+    # want_guard= reduction and raises NumericalIntegrityError on
+    # NaN/+Inf/sentinel-underflow in band tables, scores, or totals
+    guard: bool = False
+    # shadow verification: deterministically sample this fraction of
+    # completed results (by content digest) and re-score them on the
+    # independent oracle path (engine.integrity.oracle_rescore — the
+    # alternate RIFRAF_TPU_FUSED_IMPL routing). A divergence beyond the
+    # precision-harness tolerance is counted, attributed to the worker's
+    # device on the quarantine scoreboard, and the ORACLE result is
+    # returned instead of the bad answer (path="verified")
+    verify_fraction: float = 0.0
+    # suspect-device quarantine: guard trips + divergences per device
+    # before it is evicted from the round-robin; it rejoins only after
+    # passing the known-answer golden probe. 0 disables eviction.
+    # Quarantine/probes are active only when guard or verify_fraction
+    # enables the integrity layer
+    quarantine_threshold: int = 2
+    # min seconds between golden probes of a quarantined/restarted
+    # worker (rate limit on the re-probe loop)
+    probe_interval_s: float = 0.05
+
     # synchronous waits (submit_many, CLI drain) give up after this long
     # per request and report WaitTimeoutError instead of hanging on a
     # dead pipeline; requests with deadlines derive a tighter bound

@@ -59,6 +59,7 @@ from .errors import (
     WorkerCrashError,
 )
 from .faults import resolve_faults
+from .quarantine import DeviceScoreboard
 from .request import Request, Response, ServeConfig
 from .stats import ServerStats
 from .worker import STOP, Flush, Worker, respond_error
@@ -79,6 +80,16 @@ class ConsensusServer:
         self._admit_q: Queue = Queue(maxsize=self.config.max_queue)
         self._flush_q: Queue = Queue()
         self._batcher = MicroBatcher(self.config)
+        # result-integrity layer: active when the guard sentinels or
+        # shadow verification are on; the scoreboard (shared by the
+        # fleet) drives quarantine/probing
+        self._integrity = bool(self.config.guard
+                               or self.config.verify_fraction > 0)
+        self.scoreboard = DeviceScoreboard(
+            self.config.quarantine_threshold)
+        # worker slots parked after a restart whose golden probe failed:
+        # re-probed by the supervisor instead of looping restarts
+        self._parked: set = set()
         if self.config.n_workers > 1 and self.config.mesh is not None:
             raise ValueError(
                 "n_workers > 1 is the per-device fleet; configure mesh "
@@ -131,7 +142,9 @@ class ConsensusServer:
             # overlaps run k) without starving the other workers
             burst_limit = 2
         return Worker(cfg, self.stats, self.faults, device=device,
-                      burst_limit=burst_limit)
+                      burst_limit=burst_limit,
+                      scoreboard=(self.scoreboard if self._integrity
+                                  else None))
 
     @property
     def _worker(self) -> Worker:
@@ -395,6 +408,18 @@ class ConsensusServer:
     def _check_worker_slot(self, i: int) -> None:
         wt = self._worker_threads[i]
         w = self._workers[i]
+        if i in self._parked:
+            # a restarted worker whose golden probe failed: no thread
+            # is running, and that is NOT a crash — re-probe (rate
+            # limited) and spawn only on a clean pass. The restart
+            # budget is untouched: a chip that cannot answer the
+            # known-answer problem is quarantined, not restart-looped.
+            if (time.perf_counter() - w._last_probe
+                    >= self.config.probe_interval_s
+                    and w.golden_probe()):
+                self._parked.discard(i)
+                self._worker_threads[i] = self._spawn_worker(i)
+            return
         if wt is not None and wt.is_alive():
             # alive: watch for a stall (busy with no heartbeat). One
             # count per stalled burst — last_beat only moves when the
@@ -420,10 +445,19 @@ class ConsensusServer:
         self._worker_restarts += 1
         self.stats.count("worker_restarts")
         # a fresh Worker re-attaches to the module-level lru-cached
-        # program factories: no recompilation, same executables
+        # program factories: no recompilation, same executables.
+        # Crashed flushes re-queue FIRST so fleet mates can take them
+        # while this slot proves itself.
         self._workers[i] = self._make_worker(i)
-        self._worker_threads[i] = self._spawn_worker(i)
         self._requeue_crashed(crashed)
+        if self._integrity and not self._workers[i].golden_probe():
+            # failed the post-restart known-answer probe: park the slot
+            # (quarantined on the scoreboard) instead of rejoining the
+            # round-robin with a chip that returns wrong answers
+            self._worker_threads[i] = None
+            self._parked.add(i)
+            return
+        self._worker_threads[i] = self._spawn_worker(i)
 
     def _backoff(self, k: int) -> None:
         # interruptible exponential backoff before restart k
@@ -519,6 +553,14 @@ class ConsensusServer:
                         self._worker.executor.run(packed))
                     n_traced += 1
         self.stats.count("warmup_programs", n_traced)
+        if self._integrity:
+            # every fleet member proves itself on the known-answer
+            # golden problem before taking traffic; a failing device
+            # starts quarantined (its run_loop refuses flushes and
+            # re-probes until clean)
+            for w in self._workers:
+                if not w.golden_probe():
+                    self.stats.count("warmup_probe_failures")
         return n_traced
 
     def queue_depth(self) -> int:
@@ -562,6 +604,16 @@ class ConsensusServer:
                 }
                 for i, w in enumerate(self._workers)
             ]
+        if self._integrity:
+            out["integrity"] = {
+                "guard": self.config.guard,
+                "verify_fraction": self.config.verify_fraction,
+                "quarantine_threshold":
+                    self.config.quarantine_threshold,
+                "devices": self.scoreboard.snapshot(),
+                "counters": self.stats.integrity(),
+                "parked_workers": sorted(self._parked),
+            }
         if self.faults:
             out["faults"] = self.faults.snapshot()
         return out

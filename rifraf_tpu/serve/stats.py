@@ -107,6 +107,26 @@ class ServerStats:
                 if k.startswith("ladder_")
             }
 
+    # counters the result-integrity layer emits (worker._note_trip,
+    # _maybe_verify, golden_probe): collected for health()["integrity"]
+    # and the bench chaos JSON line
+    INTEGRITY_COUNTERS = (
+        "guard_trips", "divergence_trips", "verify_sampled", "verify_ok",
+        "verify_divergence", "verify_recovered", "verify_errors",
+        "injected_corrupt", "device_quarantined", "device_reinstated",
+        "probe_pass", "probe_fail", "quarantine_requeued",
+    )
+
+    def integrity(self) -> Dict[str, int]:
+        """The result-integrity counters that are non-zero (sentinel
+        trips, shadow-verification outcomes, quarantine lifecycle)."""
+        with self._lock:
+            return {
+                k: self._counters[k]
+                for k in self.INTEGRITY_COUNTERS
+                if self._counters.get(k)
+            }
+
     def snapshot(self, queue_depth: Optional[int] = None) -> dict:
         """JSON-serializable state: counters, occupancy, padding waste,
         latency percentiles (ms), decline reasons, timer sections."""

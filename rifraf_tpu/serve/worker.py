@@ -45,6 +45,9 @@ from concurrent.futures import InvalidStateError
 from queue import Empty, Queue
 from typing import List, NamedTuple, Optional
 
+import numpy as np
+
+from ..engine.integrity import IntegrityError
 from ..parallel.cluster import PipelineJobError, pipeline_map
 from ..parallel.sweep_sharded import (
     BucketPlan,
@@ -59,7 +62,8 @@ from ..utils.shapes import bucket as _bucket
 from ..utils.shapes import pack_segments
 from .batcher import resolve_segment_pack, segment_eligible
 from .errors import DeadlineExceededError, ServeError
-from .faults import FaultPlan, resolve_faults
+from .faults import FaultPlan, corrupt_value, resolve_faults
+from .quarantine import DeviceScoreboard, device_key, golden_problem
 from .request import Request, Response, ServeConfig
 from .stats import ServerStats
 
@@ -132,7 +136,8 @@ class Worker:
 
     def __init__(self, config: ServeConfig, stats: ServerStats,
                  faults: Optional[FaultPlan] = None, device=None,
-                 burst_limit: Optional[int] = None):
+                 burst_limit: Optional[int] = None,
+                 scoreboard: Optional[DeviceScoreboard] = None):
         self.config = config
         self.stats = stats
         self.faults = faults if faults is not None else resolve_faults(
@@ -154,7 +159,14 @@ class Worker:
             device=device,
             band_dtype=config.band_dtype,
             band_growth=config.band_growth,
+            want_guard=config.guard,
         )
+        # result-integrity surface: the per-device scoreboard (shared
+        # across the fleet) attributes guard trips / divergences to
+        # this worker's device and drives quarantine/probing
+        self.scoreboard = scoreboard
+        self.dev_key = device_key(device)
+        self._last_probe = -float("inf")
         # supervision surface: the supervisor reads these to detect a
         # crashed/stalled worker and to recover its in-flight requests
         self.last_beat = time.perf_counter()
@@ -313,19 +325,100 @@ class Worker:
                 handle[1], [res for _, res in pairs]
             ))
             for ci, res in pairs:
-                self._respond_ok(flush.requests[ci], res, "batched")
+                self._respond_ok(flush.requests[ci],
+                                 self._maybe_corrupt(res), "batched")
             return len(pairs)
         with self.stats.timers.time("serve_fetch"):
             results = self.executor.collect(handle)
         self.stats.note_model_bytes(_batch_model_bytes(handle[1], results))
         for req, res in zip(flush.requests, results):
-            self._respond_ok(req, res, "batched")
+            self._respond_ok(req, self._maybe_corrupt(res), "batched")
         return len(flush.requests)
+
+    def _maybe_corrupt(self, res: SweepResult) -> SweepResult:
+        """The ``corrupt`` fault kind at the fetch site: a silent,
+        deterministic float64 bit flip on a fetched score — the
+        wrong-but-plausible answer the shadow-verification layer exists
+        to catch. One corrupt-plan poll per fetched result."""
+        bit = self.faults.corrupt("fetch")
+        if bit is None:
+            return res
+        self.stats.count("injected_corrupt")
+        return res._replace(score=corrupt_value(res.score, bit))
 
     # ---- per-request terminals ----
 
+    def _note_trip(self, kind: str) -> None:
+        """One integrity trip ("guard" | "divergence") attributed to
+        this worker's device: count it, and evict the device from the
+        round-robin when it crosses the scoreboard threshold."""
+        self.stats.count(f"{kind}_trips")
+        if (self.scoreboard is not None
+                and self.scoreboard.record_trip(self.device, kind)):
+            self.stats.count("device_quarantined")
+
+    def _maybe_verify(self, req: Request,
+                      res: SweepResult) -> Optional[SweepResult]:
+        """Shadow verification: deterministically sample completed
+        results by content digest (``verify_fraction``) and re-score on
+        the independent oracle path (engine.integrity.oracle_rescore —
+        the alternate fused-impl routing, i.e. the degradation ladder's
+        rung-2 shape on the OTHER kernel). A divergence beyond the
+        precision-harness tolerance is counted, attributed to this
+        worker's device on the quarantine scoreboard, and the ORACLE
+        result replaces the bad answer (never emitted). Returns the
+        replacement, or None when verification passed / didn't sample /
+        itself failed (the primary answer stands — a broken verifier
+        must not take down serving)."""
+        cfg = self.config
+        if cfg.verify_fraction <= 0.0:
+            return None
+        from ..engine.integrity import (
+            oracle_rescore,
+            scores_diverge,
+            selected_for_verify,
+        )
+        from ..parallel.sweep_sharded import _content_digest
+
+        if not selected_for_verify(_content_digest([req.cluster]),
+                                   cfg.verify_fraction):
+            return None
+        self.stats.count("verify_sampled")
+        try:
+            with self.stats.timers.time("serve_verify"):
+                oracle = oracle_rescore(
+                    req.cluster, max_iters=cfg.max_iters,
+                    min_dist=cfg.min_dist,
+                    bandwidth_pvalue=cfg.bandwidth_pvalue,
+                    do_alignment_proposals=cfg.do_alignment_proposals,
+                    band_dtype=cfg.band_dtype,
+                    band_growth=cfg.band_growth,
+                    scores=cfg.scores, bandwidth=cfg.bandwidth,
+                )
+        except Exception:  # noqa: BLE001 — verifier failure != result
+            self.stats.count("verify_errors")
+            return None
+        want = float(oracle.state.score)
+        diverged, _tol = scores_diverge(res.score, want, cfg.band_dtype)
+        same = np.array_equal(np.asarray(res.consensus),
+                              np.asarray(oracle.consensus))
+        if same and not diverged:
+            self.stats.count("verify_ok")
+            return None
+        self.stats.count("verify_divergence")
+        self._note_trip("divergence")
+        self.stats.count("verify_recovered")
+        return SweepResult(
+            consensus=oracle.consensus, score=want,
+            n_iters=int(oracle.state.stage_iterations.sum()),
+            converged=bool(oracle.state.converged),
+        )
+
     def _respond_ok(self, req: Request, res: SweepResult,
                     path: str) -> None:
+        replacement = self._maybe_verify(req, res)
+        if replacement is not None:
+            res, path = replacement, "verified"
         lat = time.perf_counter() - req.t_submit
         response = Response(
             id=req.id, ok=True, consensus=res.consensus, score=res.score,
@@ -402,6 +495,14 @@ class Worker:
         fallback, so a transient fault there still clears. The
         per-request budget bounds the recursion."""
         cfg = self.config
+        # a tripped numerical sentinel is a ladder entry like any other
+        # failure, but it ALSO scores against this worker's device:
+        # repeated trips quarantine the chip while the ladder re-runs
+        # the requests elsewhere/simpler
+        cause = (err.__cause__ if isinstance(err, PipelineJobError)
+                 else err)
+        if isinstance(cause, IntegrityError):
+            self._note_trip("guard")
         wrapped = self._wrap(err)
         retryable: List[Request] = []
         for r in flush.requests:
@@ -448,6 +549,43 @@ class Worker:
         self._respond_ok(req, res, "fallback")
         self.stats.count("ladder_recovered")
 
+    # ---- the golden probe ----
+
+    def golden_probe(self) -> bool:
+        """Run the known-answer golden problem through this worker's
+        OWN executor (own device, own compiled path): pass iff the
+        consensus equals the planted template and the score is finite.
+        The outcome lands on the scoreboard — a pass REINSTATES a
+        quarantined device, a fail (or any exception) quarantines it.
+        Deliberately does NOT fire fault sites: the probe measures the
+        hardware, not the chaos plan."""
+        from ..parallel.sweep_sharded import bucket_key, cluster_info
+
+        cfg = self.config
+        self._last_probe = time.perf_counter()
+        try:
+            cluster, template = golden_problem(cfg)
+            info = cluster_info(cluster, cfg.band_growth)
+            key = bucket_key(info, cfg.read_bucket, cfg.band_bucket,
+                             cfg.len_bucket)
+            gp = mesh_round(1, cfg.mesh, pow2=True)
+            plan = BucketPlan(key=key, band=cfg.band_bucket, gp=gp,
+                              chunks=[list(range(gp))])
+            packed = self.executor.pack(plan, range(gp), [cluster] * gp,
+                                        [info] * gp)
+            res = self.executor.collect(self.executor.run(packed))[0]
+            ok = (np.array_equal(np.asarray(res.consensus), template)
+                  and np.isfinite(res.score))
+        except Exception:  # noqa: BLE001 — a failing probe IS the signal
+            ok = False
+        self.stats.count("probe_pass" if ok else "probe_fail")
+        if self.scoreboard is not None:
+            was = self.scoreboard.is_quarantined(self.device)
+            self.scoreboard.note_probe(self.device, ok)
+            if ok and was:
+                self.stats.count("device_reinstated")
+        return ok
+
     # ---- the consumer loop (one thread) ----
 
     def take_inflight(self) -> List[Flush]:
@@ -477,6 +615,20 @@ class Worker:
             self._heartbeat()
             if item is STOP:
                 break
+            if (self.scoreboard is not None
+                    and self.scoreboard.is_quarantined(self.device)):
+                # evicted from the round-robin: hand the flush back for
+                # fleet mates and re-probe (rate-limited); this worker
+                # takes traffic again only after a clean probe
+                flush_q.put(item)
+                self.stats.count("quarantine_requeued")
+                now = time.perf_counter()
+                if (now - self._last_probe
+                        >= self.config.probe_interval_s):
+                    self.golden_probe()
+                else:
+                    time.sleep(min(self.config.probe_interval_s, 0.01))
+                continue
             self.busy = True
             burst: List[Flush] = [item]
             while (self.burst_limit is None

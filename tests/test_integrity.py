@@ -1,0 +1,625 @@
+"""The result-integrity layer: numerical sentinels (guard reduction +
+typed decode), deterministic shadow-verification sampling and the
+precision-bound divergence test, the silent ``corrupt`` fault kind,
+journal CRC verify-on-read, the suspect-device quarantine scoreboard
+with its known-answer golden probe, and the supervisor restart ->
+probe-before-rejoin interplay.
+
+Fast tests are host-only (plus the per-cluster fallback compiles the
+fast serve suites already pay); the fused-step guard reduction, the
+guarded sweep equality, and the corrupt-site end-to-end detection run
+are marked slow. CI's integrity job runs the fast set under BOTH
+``RIFRAF_TPU_FUSED_IMPL`` settings, so each leg exercises one
+primary/oracle pairing."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from rifraf_tpu.engine.integrity import (
+    GUARD_NAN,
+    GUARD_POSINF,
+    GUARD_UNDERFLOW,
+    NumericalIntegrityError,
+    alternate_impl,
+    check_finite,
+    check_guard,
+    decode_guard,
+    oracle_impl,
+    scores_diverge,
+    selected_for_verify,
+)
+from rifraf_tpu.engine.params import RifrafParams
+from rifraf_tpu.io.journal import (
+    Journal,
+    JournalError,
+    _fsync_dir,
+    read_journal,
+)
+from rifraf_tpu.models.errormodel import ErrorModel
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.parallel.sweep_sharded import (
+    SweepResult,
+    sweep_clusters_sharded,
+)
+from rifraf_tpu.serve import (
+    ConsensusServer,
+    FaultPlan,
+    InjectedFaultError,
+    ServeConfig,
+    ServerStats,
+    submit_many,
+)
+from rifraf_tpu.serve.faults import CORRUPT_BIT, corrupt_value
+from rifraf_tpu.serve.quarantine import (
+    GOLDEN_LEN,
+    GOLDEN_READS,
+    DeviceScoreboard,
+    device_key,
+    golden_problem,
+)
+from rifraf_tpu.serve.request import Request
+from rifraf_tpu.serve.worker import Flush, Worker
+from rifraf_tpu.sim.sample import sample_sequences
+from rifraf_tpu.utils.phred import phred_to_log_p
+
+SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+
+
+def _cluster(nseqs=3, length=30, seed=0):
+    rng = np.random.default_rng(seed)
+    params = RifrafParams()
+    _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=nseqs, length=length, error_rate=0.02, rng=rng,
+        seq_errors=SEQ_ERRORS,
+    )
+    return [
+        make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                         params.bandwidth, params.scores)
+        for s, p in zip(seqs, phreds)
+    ]
+
+
+def _fast_cfg(**kw):
+    """Fallback-path config: no batch-grid compiles."""
+    kw.setdefault("batch_max_reads", 1)
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("supervise_interval_s", 0.02)
+    return ServeConfig(**kw)
+
+
+def _mk_request(cluster, cfg, rid="t0"):
+    from rifraf_tpu.parallel.sweep_sharded import bucket_key, cluster_info
+
+    info = cluster_info(cluster)
+    return Request(
+        id=rid, cluster=list(cluster), info=info,
+        key=bucket_key(info, cfg.read_bucket, cfg.band_bucket,
+                       cfg.len_bucket),
+        t_submit=time.perf_counter(), deadline=None,
+    )
+
+
+# ------------------------------------------------- guard decode / check
+
+
+def test_decode_guard():
+    assert decode_guard(0) == ()
+    assert decode_guard(GUARD_NAN) == ("nan",)
+    assert decode_guard(GUARD_POSINF) == ("posinf",)
+    assert decode_guard(GUARD_NAN | GUARD_UNDERFLOW) == (
+        "nan", "underflow")
+
+
+def test_check_guard_clean_and_trip():
+    check_guard(np.zeros(5), "adapt")  # clean: no raise
+    g = np.zeros(5)
+    g[2] = GUARD_NAN | GUARD_POSINF
+    with pytest.raises(NumericalIntegrityError) as ei:
+        check_guard(g, "adapt", device="dev0",
+                    lane_map=["r7", "r8", "r9", "r10"])
+    err = ei.value
+    assert err.code == "numerical_integrity"
+    assert err.stage == "adapt"
+    assert err.lane == 2
+    assert set(err.flags) == {"nan", "posinf"}
+    assert err.device == "dev0"
+    assert err.context["owner"] == "r9"
+
+
+def test_check_guard_dense_total_lane():
+    g = np.zeros(4)
+    g[-1] = GUARD_UNDERFLOW
+    with pytest.raises(NumericalIntegrityError) as ei:
+        check_guard(g, "stage")
+    assert ei.value.lane == -1
+    assert "dense total" in str(ei.value)
+
+
+def test_check_guard_nonfinite_flag_word_is_a_trip():
+    """A corrupted guard WORD (NaN where an int bitmask should be) is
+    itself a trip, never a silent pass."""
+    g = np.zeros(3)
+    g[0] = np.nan
+    with pytest.raises(NumericalIntegrityError) as ei:
+        check_guard(g, "adapt")
+    assert "nan" in ei.value.flags
+
+
+def test_check_finite():
+    check_finite([-1.0, -np.inf], "score")  # -inf is the legal sentinel
+    with pytest.raises(NumericalIntegrityError):
+        check_finite([0.0, np.nan], "score")
+    with pytest.raises(NumericalIntegrityError) as ei:
+        check_finite(np.inf, "total", what="total")
+    assert ei.value.lane == -1
+
+
+def test_pack_layout_guard_appended_last():
+    from rifraf_tpu.ops.fused import pack_layout
+
+    for want_stats in (False, True):
+        base = pack_layout(5, 33, want_stats)
+        guarded = pack_layout(5, 33, want_stats, want_guard=True)
+        # every pre-guard offset is untouched: integrity off stays
+        # byte-identical, integrity on only APPENDS
+        for name, sl in base.items():
+            assert guarded[name] == sl
+        assert set(guarded) - set(base) == {"guard"}
+        a, b = guarded["guard"]
+        assert b - a == 5 + 1  # per-read words + the dense-total word
+        assert a == max(stop for _, stop in base.values())
+
+
+# -------------------------------------------- shadow-verify primitives
+
+
+def test_selected_for_verify_deterministic_and_monotone():
+    digests = [f"cluster-{i}" for i in range(400)]
+    sel_20 = {d for d in digests if selected_for_verify(d, 0.2)}
+    sel_60 = {d for d in digests if selected_for_verify(d, 0.6)}
+    # deterministic (digest-keyed, no RNG state) and monotone in the
+    # fraction: raising verify_fraction only ADDS results
+    assert sel_20 == {d for d in digests if selected_for_verify(d, 0.2)}
+    assert sel_20 <= sel_60
+    assert 0 < len(sel_20) < len(sel_60) < len(digests)
+    assert not any(selected_for_verify(d, 0.0) for d in digests)
+    assert all(selected_for_verify(d, 1.0) for d in digests)
+
+
+def test_scores_diverge_precision_bounds():
+    # f32: the precision harness's 1e-6 absolute log10 bound
+    assert not scores_diverge(-100.0, -100.0 + 5e-7)[0]
+    assert scores_diverge(-100.0, -100.0 + 1e-5)[0]
+    # bf16: tolerance scales with |score| like the bf16 band store's
+    # per-value error
+    diverged, tol = scores_diverge(-1000.0, -1010.0, "bf16")
+    assert not diverged and tol > 10
+    assert scores_diverge(-1000.0, -1030.0, "bf16")[0]
+    # finiteness mismatches always diverge; matching -inf does not
+    assert scores_diverge(-np.inf, -100.0)[0]
+    assert not scores_diverge(-np.inf, -np.inf)[0]
+    assert scores_diverge(np.inf, -np.inf)[0]
+
+
+def test_oracle_impl_pins_alternate_routing():
+    from rifraf_tpu.ops.fused_pallas import fused_impl
+
+    primary = fused_impl()
+    alt = alternate_impl()
+    assert {primary, alt} == {"mega", "split"}
+    with oracle_impl() as impl:
+        assert impl == alt
+        assert os.environ["RIFRAF_TPU_FUSED_IMPL"] == alt
+        assert fused_impl() == alt
+    assert fused_impl() == primary  # env restored on exit
+
+
+# --------------------------------------------- the corrupt fault kind
+
+
+def test_corrupt_value_involution():
+    for x in (-12.375, 0.0, 3.14159, -1e-30):
+        y = corrupt_value(x, 51)
+        assert y != x
+        assert corrupt_value(y, 51) == x  # flip twice = identity
+    # the default bit is the float64 top mantissa bit
+    assert corrupt_value(1.0) == 1.5
+
+
+def test_fault_plan_corrupt_parse_and_fire_skips():
+    plan = FaultPlan.parse("fetch:corrupt:n=2,bit=12")
+    s = plan.specs[0]
+    assert (s.site, s.kind, s.n, s.bit) == ("fetch", "corrupt", 2, 12)
+    plan.fire("fetch")  # the raising path ignores corrupt specs
+    assert s.fired == 0
+    assert plan.corrupt("fetch") == 12
+    assert plan.corrupt("fetch") == 12
+    assert plan.corrupt("fetch") is None  # n=2 exhausted
+    assert plan.corrupt("dispatch") is None  # other sites unaffected
+    snap = plan.snapshot()
+    assert snap["site_calls"]["fetch~corrupt"] == 3
+    assert snap["specs"][0]["fired"] == 2
+
+
+def test_fault_plan_corrupt_counter_independent_of_fire():
+    plan = FaultPlan.parse("fetch:corrupt:n=1,after=2;fetch:error:n=1")
+    with pytest.raises(InjectedFaultError):
+        plan.fire("fetch")
+    # raising invocations must NOT advance the corrupt gating counter
+    assert plan.corrupt("fetch") is None  # corrupt invocation 0
+    assert plan.corrupt("fetch") is None  # 1
+    assert plan.corrupt("fetch") == CORRUPT_BIT  # 2: after=2 satisfied
+
+
+def test_worker_maybe_corrupt_counts_and_flips():
+    cfg = _fast_cfg(supervise=False, faults="fetch:corrupt:n=1,bit=50")
+    stats = ServerStats()
+    w = Worker(cfg, stats)
+    res = SweepResult(consensus=np.array([1, 2], np.int8), score=-42.5,
+                      n_iters=3, converged=True)
+    out = w._maybe_corrupt(res)
+    assert out.score == corrupt_value(-42.5, 50)
+    assert out.score != -42.5
+    assert np.array_equal(out.consensus, res.consensus)
+    assert stats.integrity()["injected_corrupt"] == 1
+    assert w._maybe_corrupt(res) is res  # plan exhausted: untouched
+
+
+# ----------------------------------------------- journal CRC satellite
+
+
+def test_journal_crc_round_trip(tmp_path):
+    p = str(tmp_path / "run.journal.jsonl")
+    with Journal(p, header={"fingerprint": "abc"}) as j:
+        j.append({"kind": "chunk", "i": 0})
+        j.append({})  # the empty-record splice edge case
+    records, torn = read_journal(p)
+    assert not torn
+    assert records == [
+        {"kind": "header", "fingerprint": "abc"},
+        {"kind": "chunk", "i": 0},
+        {},
+    ]
+    raw = open(p).read()
+    assert raw.count('"crc"') == 3  # every appended line carries one
+
+
+def test_journal_in_place_corruption_refuses_resume(tmp_path):
+    p = str(tmp_path / "run.journal.jsonl")
+    with Journal(p, header={"fingerprint": "abc"}) as j:
+        j.append({"kind": "chunk", "i": 0})
+        j.append({"kind": "chunk", "i": 1})
+    lines = open(p).readlines()
+    # flip a value INSIDE record 1's body: still complete JSON, so only
+    # the CRC can catch it
+    lines[1] = lines[1].replace('"i": 0', '"i": 7')
+    with open(p, "w") as fh:
+        fh.writelines(lines)
+    with pytest.raises(JournalError, match="record 1"):
+        read_journal(p)
+
+
+def test_journal_torn_tail_still_tolerated(tmp_path):
+    p = str(tmp_path / "run.journal.jsonl")
+    with Journal(p, header={"fingerprint": "abc"}) as j:
+        j.append({"kind": "chunk", "i": 0})
+    with open(p, "ab") as fh:
+        fh.write(b'{"kind": "chu')  # the append a crash interrupted
+    records, torn = read_journal(p)
+    assert torn
+    assert [r.get("i") for r in records] == [None, 0]
+
+
+def test_journal_legacy_without_crc_still_reads(tmp_path):
+    p = str(tmp_path / "legacy.journal.jsonl")
+    with open(p, "w") as fh:
+        fh.write('{"kind": "header", "fingerprint": "abc"}\n')
+        fh.write('{"kind": "chunk", "i": 0}\n')
+    records, torn = read_journal(p)
+    assert not torn
+    assert records[1] == {"kind": "chunk", "i": 0}
+
+
+def test_fsync_dir_best_effort():
+    _fsync_dir("/nonexistent/dir/for/sure/x.jsonl")  # silently skipped
+    _fsync_dir(os.path.join(os.getcwd(), "x.jsonl"))
+
+
+# --------------------------------------- quarantine scoreboard + probe
+
+
+def test_scoreboard_threshold_and_reinstate():
+    sb = DeviceScoreboard(threshold=2)
+    assert not sb.record_trip("d0", "guard")
+    # crossing the threshold quarantines and returns True exactly once
+    assert sb.record_trip("d0", "divergence")
+    assert sb.is_quarantined("d0")
+    assert not sb.record_trip("d0", "guard")
+    assert sb.any_quarantined()
+    assert not sb.is_quarantined("d1")
+    # a failing probe keeps it out; a passing one reinstates and zeroes
+    # the trip counters (the device starts clean)
+    assert sb.note_probe("d0", ok=False)
+    assert not sb.note_probe("d0", ok=True)
+    assert not sb.is_quarantined("d0")
+    assert sb.snapshot()["d0"] == {
+        "quarantined": False, "guard_trips": 0, "divergences": 0,
+        "probes_pass": 1, "probes_fail": 1,
+    }
+
+
+def test_scoreboard_threshold_zero_counts_without_evicting():
+    sb = DeviceScoreboard(threshold=0)
+    for _ in range(5):
+        assert not sb.record_trip(None, "guard")
+    assert not sb.is_quarantined(None)
+    assert sb.snapshot()["default"]["guard_trips"] == 5
+    with pytest.raises(ValueError):
+        sb.record_trip(None, "bogus")
+
+
+def test_device_key():
+    assert device_key(None) == "default"
+    assert device_key("TPU:3") == "TPU:3"
+
+
+def test_golden_problem_deterministic():
+    cfg = ServeConfig()
+    c1, t1 = golden_problem(cfg)
+    c2, t2 = golden_problem(cfg)
+    assert len(t1) == GOLDEN_LEN
+    assert len(c1) == GOLDEN_READS
+    assert np.array_equal(t1, t2)
+    for r1, r2 in zip(c1, c2):
+        assert np.array_equal(r1.seq, r2.seq)
+        assert np.array_equal(r1.seq, t1)  # error-free copies
+
+
+def test_worker_note_trip_quarantines_at_threshold():
+    cfg = _fast_cfg(supervise=False, guard=True)
+    stats = ServerStats()
+    sb = DeviceScoreboard(threshold=2)
+    w = Worker(cfg, stats, scoreboard=sb)
+    w._note_trip("guard")
+    assert "device_quarantined" not in stats.integrity()
+    w._note_trip("divergence")
+    ctr = stats.integrity()
+    assert ctr["guard_trips"] == 1
+    assert ctr["divergence_trips"] == 1
+    assert ctr["device_quarantined"] == 1
+    assert sb.is_quarantined(None)
+
+
+def test_retry_ladder_scores_integrity_cause():
+    """A tripped sentinel entering the ladder also scores against the
+    worker's device on the shared scoreboard."""
+    cfg = _fast_cfg(supervise=False, guard=True, max_retries=0)
+    stats = ServerStats()
+    sb = DeviceScoreboard(threshold=1)
+    w = Worker(cfg, stats, scoreboard=sb)
+    req = _mk_request(_cluster(), cfg)
+    err = NumericalIntegrityError("adapt", 0, GUARD_NAN)
+    w._retry_or_fail(Flush("batch", [req]), err)
+    assert sb.is_quarantined(None)
+    assert stats.integrity()["guard_trips"] == 1
+    res = req.future.result(timeout=0)
+    assert not res.ok  # budget 0: typed failure, not a hang
+
+
+# ------------------------------------------ shadow verification (serve)
+
+
+def test_worker_shadow_verify_catches_corrupted_score():
+    cfg = _fast_cfg(supervise=False, verify_fraction=1.0)
+    stats = ServerStats()
+    w = Worker(cfg, stats, scoreboard=DeviceScoreboard(threshold=9))
+    req = _mk_request(_cluster(), cfg)
+    good = w._run_fallback(req)  # ground truth via the worker's rung 2
+    # a clean result verifies clean (no replacement)
+    assert w._maybe_verify(req, good) is None
+    ctr = stats.integrity()
+    assert ctr["verify_sampled"] == 1 and ctr["verify_ok"] == 1
+    # a silently corrupted score is detected and REPLACED by the oracle
+    bad = good._replace(score=corrupt_value(good.score))
+    repl = w._maybe_verify(req, bad)
+    assert repl is not None
+    assert repl.score == pytest.approx(good.score, abs=1e-6)
+    assert np.array_equal(repl.consensus, good.consensus)
+    ctr = stats.integrity()
+    assert ctr["verify_divergence"] == 1
+    assert ctr["verify_recovered"] == 1
+    assert ctr["divergence_trips"] == 1
+
+
+# --------------------- supervisor restart -> golden-probe interplay
+
+
+def test_restart_probe_gates_rejoin_and_parks_on_failure(monkeypatch):
+    """A restarted worker must PASS the known-answer probe before
+    rejoining the round-robin; while it keeps failing, the slot stays
+    parked (re-probed, NOT restart-looped) and its requeued work waits
+    for a clean pass."""
+    probe_ok = {"ok": False}
+
+    def fake_probe(self):
+        self._last_probe = time.perf_counter()
+        ok = probe_ok["ok"]
+        self.stats.count("probe_pass" if ok else "probe_fail")
+        if self.scoreboard is not None:
+            was = self.scoreboard.is_quarantined(self.device)
+            self.scoreboard.note_probe(self.device, ok)
+            if ok and was:
+                self.stats.count("device_reinstated")
+        return ok
+
+    monkeypatch.setattr(Worker, "golden_probe", fake_probe)
+    cfg = _fast_cfg(guard=True, probe_interval_s=0.01,
+                    faults="fallback:crash:n=1")
+    srv = ConsensusServer(cfg)
+    try:
+        fut = srv.submit(_cluster())
+        deadline = time.perf_counter() + 60
+        while time.perf_counter() < deadline:
+            ctr = srv.health().get("integrity", {}).get("counters", {})
+            if ctr.get("probe_fail", 0) >= 3:
+                break
+            time.sleep(0.02)
+        h = srv.health()
+        # exactly ONE restart: the parked slot re-probes without
+        # burning more restart budget, however long the probe fails
+        assert h["worker_restarts"] == 1
+        assert h["integrity"]["parked_workers"] == [0]
+        assert h["integrity"]["devices"]["default"]["quarantined"]
+        assert h["integrity"]["counters"]["probe_fail"] >= 3
+        assert not fut.done()  # the requeued work waits, not fails
+        probe_ok["ok"] = True
+        res = fut.result(timeout=60)
+        assert res.ok
+        h = srv.health()
+        assert h["integrity"]["parked_workers"] == []
+        assert h["worker_restarts"] == 1
+        assert h["integrity"]["counters"]["probe_pass"] >= 1
+        assert h["integrity"]["counters"]["device_reinstated"] >= 1
+        assert not h["integrity"]["devices"]["default"]["quarantined"]
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------- slow: on-device
+
+
+@pytest.mark.slow
+def test_fused_guard_layout_identical_and_flags_nan():
+    """want_guard appends flags without perturbing a single pre-guard
+    word; a NaN poisoned into one read's inputs trips exactly that
+    read's guard lane."""
+    import jax.numpy as jnp
+
+    from rifraf_tpu.models.errormodel import Scores
+    from rifraf_tpu.models.sequences import batch_reads
+    from rifraf_tpu.ops import align_jax
+    from rifraf_tpu.ops.fused import fused_step_full, pack_layout
+
+    scores = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0))
+    rng = np.random.default_rng(3)
+    tlen, n_reads = 48, 7
+    template = rng.integers(0, 4, size=tlen).astype(np.int8)
+    reads = []
+    for _ in range(n_reads):
+        slen = int(rng.integers(tlen - 5, tlen + 6))
+        s = rng.integers(0, 4, size=slen).astype(np.int8)
+        log_p = rng.uniform(-3.0, -0.5, size=slen)
+        reads.append(make_read_scores(s, log_p, 8, scores))
+    batch = batch_reads(reads, dtype=np.float64)
+    K = ((align_jax.band_height(batch, tlen) + 7) // 8) * 8
+    geom = align_jax.batch_geometry(batch, tlen)
+    t = jnp.asarray(np.pad(template, (0, 8)), jnp.int8)
+    w = jnp.asarray(rng.uniform(0.5, 2.0, n_reads))
+    args = (t, jnp.asarray(batch.seq), jnp.asarray(batch.match),
+            jnp.asarray(batch.mismatch), jnp.asarray(batch.ins),
+            jnp.asarray(batch.dels), geom, w)
+    T1 = t.shape[0] + 1
+
+    _, _, _, packed = fused_step_full(*args, K, False, True)
+    _, _, _, packed_g = fused_step_full(*args, K, False, True,
+                                        want_guard=True)
+    lay = pack_layout(n_reads, T1, True)
+    lay_g = pack_layout(n_reads, T1, True, want_guard=True)
+    ref, chk = np.asarray(packed), np.asarray(packed_g)
+    for name, (a, b) in lay.items():
+        np.testing.assert_array_equal(
+            chk[a:b], ref[a:b],
+            err_msg=f"guarded layout perturbed section {name!r}")
+    ga, gb = lay_g["guard"]
+    assert gb == chk.size
+    assert np.all(chk[ga:gb] == 0)  # clean inputs: no flags
+    check_guard(chk[ga:gb], "stage")  # no raise
+
+    bad_match = np.array(batch.match)
+    bad_match[3] = np.nan  # poison read 3's match scores
+    _, _, _, packed_bad = fused_step_full(
+        args[0], args[1], jnp.asarray(bad_match), args[3], args[4],
+        args[5], geom, w, K, False, True, want_guard=True,
+    )
+    guard_bad = np.asarray(packed_bad)[ga:gb]
+    with pytest.raises(NumericalIntegrityError) as ei:
+        check_guard(guard_bad, "stage")
+    assert ei.value.lane == 3
+    assert "nan" in ei.value.flags
+
+
+@pytest.mark.slow
+def test_sweep_guard_and_verify_bit_identical_when_clean():
+    """Integrity ON over healthy inputs changes nothing: the guarded +
+    fully-verified sweep returns the plain sweep's results exactly."""
+    rng = np.random.default_rng(11)
+    params = RifrafParams()
+    clusters = []
+    for _ in range(3):
+        _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+            nseqs=4, length=50, error_rate=0.03, rng=rng,
+            seq_errors=SEQ_ERRORS,
+        )
+        clusters.append([
+            make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                             params.bandwidth, params.scores)
+            for s, p in zip(seqs, phreds)
+        ])
+    plain = sweep_clusters_sharded(clusters)
+    checked = sweep_clusters_sharded(clusters, guard=True,
+                                     verify_fraction=1.0)
+    for a, b in zip(plain, checked):
+        assert np.array_equal(a.consensus, b.consensus)
+        assert a.score == b.score
+        assert a.n_iters == b.n_iters
+        assert a.converged == b.converged
+
+
+@pytest.mark.slow
+def test_serve_corrupt_faults_detected_and_recovered():
+    """End-to-end under fire: fetch-site corrupt faults at
+    verify_fraction=1.0 — every injected corruption detected, every
+    answer bit-identical to the unfaulted reference, the poisoned
+    device quarantined (and reinstated by the golden probe, since the
+    chip itself is healthy)."""
+    clusters = [_cluster(seed=s) for s in range(8)]
+    base = dict(max_wait_ms=5.0, supervise=False,
+                result_timeout_s=300.0)
+    with ConsensusServer(ServeConfig(**base)) as ref_srv:
+        ref = submit_many(clusters, server=ref_srv)
+    assert all(r.ok for r in ref)
+
+    srv = ConsensusServer(ServeConfig(
+        guard=True, verify_fraction=1.0, quarantine_threshold=2,
+        probe_interval_s=0.01, faults="fetch:corrupt:n=3", **base))
+    # wave 1 rides the corrupt plan; the second divergence crosses the
+    # threshold and quarantines the (only) device
+    out = submit_many(clusters[:6], server=srv)
+    # wave 2 arrives at a quarantined worker: its run_loop requeues the
+    # flush and runs the REAL golden probe — the chip is healthy (the
+    # corruption was injected, n=3 exhausted), so it reinstates and
+    # serves the requeued work
+    out += submit_many(clusters[6:], server=srv)
+    health = srv.health()
+    srv.close()
+
+    assert all(r.ok for r in out)  # availability under fire: 100%
+    for r, g in zip(out, ref):
+        assert np.array_equal(r.consensus, g.consensus)
+        assert r.score == g.score  # recovered answers bit-identical
+    ctr = health["integrity"]["counters"]
+    assert ctr["injected_corrupt"] == 3
+    assert ctr["verify_divergence"] == 3  # 100% detection
+    assert ctr["verify_recovered"] == 3
+    assert ctr["device_quarantined"] >= 1
+    assert ctr["quarantine_requeued"] >= 1
+    assert ctr["probe_pass"] >= 1  # healthy chip reinstated
+    assert ctr["device_reinstated"] >= 1
+    assert not health["integrity"]["devices"]["default"]["quarantined"]
+    assert sum(1 for r in out if r.path == "verified") == 3

@@ -135,6 +135,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "one device round-robin over jax.devices() "
                         "(compiled programs and the persistent cache "
                         "are shared, so the bucket grid warms once)")
+    p.add_argument("--min-workers", type=int, default=0,
+                   help="elastic fleet floor: with --max-workers set, "
+                        "the supervisor never drains below this many "
+                        "workers (0 = 1)")
+    p.add_argument("--max-workers", type=int, default=0,
+                   help="elastic fleet ceiling: 0 (default) disables "
+                        "autoscaling; otherwise the supervisor adds "
+                        "workers on queue pressure (depth or "
+                        "time-in-queue) and gracefully drains idle ones "
+                        "back down (parked/quarantined slots never "
+                        "count toward the target)")
+    p.add_argument("--shed", action="store_true",
+                   help="deadline-aware load shedding: reject a request "
+                        "at admission (typed 'shedded' error with a "
+                        "retry-after hint) when the estimated queue "
+                        "service time already exceeds its deadline")
+    p.add_argument("--aot-cache", default="",
+                   help="persisted AOT executable cache: 'default' for "
+                        "the fingerprinted per-machine directory "
+                        "(~/.cache/rifraf_tpu_aot), a path, or empty "
+                        "(default) to fall back to the "
+                        "RIFRAF_TPU_AOT_CACHE env var; a warmed "
+                        "process exports each compiled program so cold "
+                        "restarts load executables from disk instead "
+                        "of re-tracing")
     p.add_argument("--deadline-ms", type=float, default=0.0,
                    help="default per-request deadline applied to requests "
                         "without their own (0 = none)")
@@ -176,12 +201,17 @@ def config_from_args(args) -> ServeConfig:
         max_iters=args.max_iters,
         do_alignment_proposals=args.alignment_proposals,
         n_workers=max(1, args.workers),
+        min_workers=max(0, args.min_workers),
+        max_workers=max(0, args.max_workers),
+        shed=args.shed,
         band_dtype=args.band_dtype,
         band_growth=args.band_growth,
         guard=args.guard,
         verify_fraction=args.verify_fraction,
         quarantine_threshold=args.quarantine_threshold,
     )
+    if args.aot_cache:
+        kw["aot_cache"] = args.aot_cache
     if args.seq_errors:
         kw["scores"] = parse_error_model(args.seq_errors)
     if args.faults:

@@ -238,6 +238,7 @@ def make_stage_runner(
     gate: str = "none",
     plan=None,
     seg_step_fn: Callable = None,
+    aot_key=None,
 ):
     """Build the jitted whole-stage runner. ``step_fn`` takes the
     device-resident batch state as an ARGUMENT pytree (not a closure) so
@@ -464,7 +465,14 @@ def make_stage_runner(
 
     # the raw compiled whole-stage program: callers that batch a CLUSTER
     # axis (parallel.sweep_sharded) vmap this directly and unpack the
-    # packed rows themselves
+    # packed rows themselves. ``aot_key`` (kind, *statics) routes it
+    # through the serve.aot persisted-executable cache — a pass-through
+    # until a cache is activated, then a cold process loads the
+    # serialized module instead of re-tracing this whole stage loop.
+    if aot_key is not None:
+        from ..serve.aot import aot_program
+
+        run = aot_program(aot_key[0], tuple(aot_key[1:]), run)
     runner.run = run
     runner.plan = plan
     return runner

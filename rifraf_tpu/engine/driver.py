@@ -178,10 +178,17 @@ def recover_stale_cache(err) -> bool:
         cache_dir = jax.config.jax_compilation_cache_dir
         n = clear_cache_dir(cache_dir)
         jax.config.update("jax_enable_compilation_cache", False)
+        # the AOT-executable cache (serve.aot) carries the same
+        # staleness mode — serialized modules the upgraded runtime
+        # refuses — so recovery drops it in the same stroke
+        from ..serve.aot import clear_aot_cache
+
+        n_aot = clear_aot_cache()
         sys.stderr.write(
             f"rifraf-tpu: stale persistent compilation cache detected "
-            f"({err!r}); dropped {n} entries from {cache_dir!r} and "
-            "disabled the cache for this process\n"
+            f"({err!r}); dropped {n} entries from {cache_dir!r} plus "
+            f"{n_aot} AOT executables and disabled the cache for this "
+            "process\n"
         )
     except Exception:
         return False

@@ -1435,6 +1435,10 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
         do_subs=do_subs, gate="seeds" if seed_gate else "none",
+        aot_key=("realign_frame",
+                 K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
+                 min_dist, history_cap, stop_on_same, Kc, T1pc, nrows,
+                 do_cins, do_cdel, seed_gate, band_dtype),
     )
 
 
@@ -1538,6 +1542,10 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
         gate="edits" if use_edits else "none", seg_step_fn=seg_step,
+        aot_key=("realign_stage",
+                 K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
+                 history_cap, stop_on_same, use_edits, seg_pair,
+                 band_dtype),
     )
 
 

@@ -574,7 +574,12 @@ def _adapt_program(Tmax: int, K: int, want_edge: bool = False,
             out.append(packed[slice(*lay["guard"])])
         return tuple(out)
 
-    return jax.jit(jax.vmap(one))
+    from ..serve.aot import aot_program
+
+    return aot_program(
+        "sweep_adapt", (Tmax, K, want_edge, band_dtype, want_guard),
+        jax.jit(jax.vmap(one)),
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -617,7 +622,13 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
             in_axes=(0, 0, ((0, 0, 0, 0, 0), 0, 0, 0)),
         )(t0, tl, step_state)
 
-    return jax.jit(call, donate_argnums=(2,) if donate else ())
+    from ..serve.aot import aot_program
+
+    return aot_program(
+        "sweep_stage",
+        (Tmax, K, H, min_dist, use_edits, donate, band_dtype),
+        jax.jit(call, donate_argnums=(2,) if donate else ()),
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -648,7 +659,13 @@ def _seg_adapt_program(Tmax: int, K: int, S: int,
             res.append(out["guard"])
         return tuple(res)
 
-    return jax.jit(jax.vmap(one))
+    from ..serve.aot import aot_program
+
+    return aot_program(
+        "sweep_seg_adapt",
+        (Tmax, K, S, want_edge, band_dtype, want_guard),
+        jax.jit(jax.vmap(one)),
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -695,7 +712,13 @@ def _seg_stage_program(Tmax: int, K: int, H: int, min_dist: int,
             in_axes=(0, 0, 0, ((0, 0, 0, 0, 0), 0, 0, 0, 0)),
         )(t0, tl, live, step_state)
 
-    return jax.jit(call, donate_argnums=(3,) if donate else ())
+    from ..serve.aot import aot_program
+
+    return aot_program(
+        "sweep_seg_stage",
+        (Tmax, K, H, min_dist, use_edits, donate, S, band_dtype),
+        jax.jit(call, donate_argnums=(3,) if donate else ()),
+    )
 
 
 class ChunkExecutor:

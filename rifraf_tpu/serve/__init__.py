@@ -11,6 +11,7 @@ failed micro-batches at progressively simpler execution rungs. See
 docs/serving.md.
 """
 
+from .aot import AotCache, clear_aot_cache, resolve_aot_dir
 from .batcher import MicroBatcher
 from .errors import (
     DeadlineExceededError,
@@ -21,6 +22,7 @@ from .errors import (
     ServeError,
     ServerClosedError,
     ServerUnhealthyError,
+    SheddedError,
     WaitTimeoutError,
     WorkerCrashError,
 )
@@ -42,6 +44,7 @@ from .stats import ServerStats
 from .worker import InternalError
 
 __all__ = [
+    "AotCache",
     "ConsensusServer",
     "DeadlineExceededError",
     "DeviceScoreboard",
@@ -65,8 +68,11 @@ __all__ = [
     "ServerClosedError",
     "ServerStats",
     "ServerUnhealthyError",
+    "SheddedError",
     "WaitTimeoutError",
     "WorkerCrashError",
+    "clear_aot_cache",
     "encode_cluster",
+    "resolve_aot_dir",
     "submit_many",
 ]

@@ -1,0 +1,342 @@
+"""AOT executable persistence: near-free cold starts for the serve grid.
+
+The persistent XLA compilation cache (engine.driver) removes the
+COMPILE half of a cold start, but a fresh process still pays full
+Python tracing + lowering for every bucket-grid program — tens of
+seconds of host work before the first flush can run. This module
+removes the other half: warmed programs are lowered once through
+``jax.export``, serialized, and persisted in a machine-fingerprinted
+cache directory; a cold process (or a PR-7 supervisor restart, or a
+PR-11 post-quarantine probe) deserializes the StableHLO payload and
+compiles it directly, skipping tracing entirely.
+
+Wiring: the module-level program factories in
+``parallel.sweep_sharded`` (and the whole-stage runners built by
+``engine.device_loop.make_stage_runner`` for ``engine.realign``) route
+their jitted callables through :func:`aot_program`. The returned
+``_Program`` is a zero-overhead pass-through while no cache is active
+(``_ACTIVE is None`` — the default path stays byte-identical); once a
+cache is activated (``ServeConfig.aot_cache``, the serve CLI's
+``--aot-cache``, or :func:`activate_from_env`), every call consults the
+cache keyed on (program kind, static config, argument avals, jax
+version, backend, fused-impl routing):
+
+- HIT: ``jax.export.deserialize(payload).call`` wrapped in ``jax.jit``
+  — compiled from the serialized module, no tracing of the original
+  function;
+- MISS: the original jitted callable runs, then the traced computation
+  is exported and persisted (atomic write) best-effort. Export failures
+  (e.g. Pallas custom calls without serialization guarantees) are
+  counted, never raised — persistence must not take down serving.
+
+Entries are machine-specific like the XLA cache
+(utils.cachedir.machine_cache_dir), and the PR-8 stale-cache recovery
+path (engine.driver.recover_stale_cache) clears this directory along
+with the compilation cache: a loaded-but-unrunnable payload falls back
+to the traced original on its first call, so a poisoned entry degrades
+to a warm miss instead of an outage.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ..utils.cachedir import (
+    atomic_write_bytes,
+    clear_cache_dir,
+    default_aot_cache_dir,
+)
+
+# the process-wide active cache: installed by activate(), consulted by
+# every _Program call. Module-level like the persistent compilation
+# cache — the executable set is shared by design (a serving bucket and
+# an offline sweep chunk use the same programs).
+_ACTIVE: Optional["AotCache"] = None
+_LOCK = threading.Lock()
+
+
+def _env_key() -> str:
+    """Environment facts that change compiled programs but are not in
+    the factories' static keys: the fused-step routing env gate and the
+    x64 flag (both flip executables under an unchanged call shape)."""
+    import jax
+
+    return "|".join((
+        jax.__version__,
+        jax.default_backend(),
+        os.environ.get("RIFRAF_TPU_FUSED_IMPL", ""),
+        "x64" if jax.config.jax_enable_x64 else "x32",
+    ))
+
+
+def _avals_digest(kind: str, statics: tuple, args) -> str:
+    """Stable entry key: program kind + static config + the argument
+    avals (shape/dtype/weak-type over the flattened pytree — weak types
+    matter: a weak f32 scalar and a committed one lower differently)."""
+    import jax
+    from jax.api_util import shaped_abstractify
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = [kind, repr(statics), _env_key(), str(treedef)]
+    for leaf in leaves:
+        a = shaped_abstractify(leaf)
+        parts.append(
+            f"{tuple(a.shape)}:{a.dtype}:{int(getattr(a, 'weak_type', False))}"
+        )
+    return hashlib.sha256("\x1f".join(parts).encode()).hexdigest()[:32]
+
+
+class AotCache:
+    """One persisted-executable directory: load/export + counters.
+
+    Layout: ``<dir>/<kind>/<digest>.jaxexp`` — one serialized
+    ``jax.export.Exported`` per (statics, avals, environment) key, kind
+    subdirectories so an operator can inspect which program family owns
+    the bytes. Counters (``snapshot()``) feed ``health()`` and the
+    bench cold-start report.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        # digest -> compiled callable (or None: load/entry known bad,
+        # pinned to the traced original)
+        self._loaded: Dict[str, Optional[Callable]] = {}
+        self._exported: set = set()
+        self.counters: Dict[str, int] = {
+            "aot_loads": 0, "aot_exports": 0, "aot_misses": 0,
+            "aot_load_errors": 0, "aot_export_errors": 0,
+        }
+
+    def _count(self, name: str) -> None:
+        with self._lock:
+            self.counters[name] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"dir": self.path, **self.counters}
+
+    def _entry_path(self, kind: str, digest: str) -> str:
+        return os.path.join(self.path, kind, f"{digest}.jaxexp")
+
+    def clear(self) -> int:
+        """Drop every persisted entry (stale-cache recovery); in-memory
+        compiled callables stay — they already run correctly."""
+        with self._lock:
+            self._exported.clear()
+        return clear_cache_dir(self.path)
+
+    # ---- the load/export protocol (called by _Program) ----
+
+    def lookup(self, kind: str, digest: str) -> Optional[Callable]:
+        """The compiled callable for an entry, loading it from disk on
+        first sight. Returns None when the entry is absent (the caller
+        exports) or known-bad (the caller runs the traced original —
+        ``known_bad`` distinguishes the two)."""
+        with self._lock:
+            if digest in self._loaded:
+                return self._loaded[digest]
+        path = self._entry_path(kind, digest)
+        if not os.path.exists(path):
+            return None
+        fn: Optional[Callable] = None
+        try:
+            import jax
+            from jax import export as jax_export
+
+            with open(path, "rb") as fh:
+                exported = jax_export.deserialize(fh.read())
+            fn = jax.jit(exported.call)
+            self._count("aot_loads")
+        except Exception:  # noqa: BLE001 — a bad payload = warm miss
+            self._count("aot_load_errors")
+        with self._lock:
+            self._loaded[digest] = fn
+            if fn is not None:
+                self._exported.add(digest)
+        return fn
+
+    def known_bad(self, digest: str) -> bool:
+        with self._lock:
+            return self._loaded.get(digest, "absent") is None
+
+    def discard(self, digest: str) -> None:
+        """Pin an entry to the traced original after its loaded form
+        failed at run time (a deserialized module the current runtime
+        refuses — e.g. an unregistered custom call)."""
+        self._count("aot_load_errors")
+        with self._lock:
+            self._loaded[digest] = None
+
+    def export(self, kind: str, digest: str, jitted: Callable,
+               args) -> Optional[Callable]:
+        """Best-effort persist: lower ``jitted`` at the call's avals
+        through jax.export, write the serialized module atomically, and
+        return the jit of the EXPORTED call. The caller runs THAT form,
+        so the one compile the warm process pays is the same compile a
+        cold process replays out of the persistent XLA cache — the
+        exported module and the original jit lower to different cache
+        keys, and compiling only the original would leave every first
+        cold start paying a full compile anyway. Never raises — a
+        program that cannot serialize (Pallas custom calls, donation
+        quirks) just stays trace-warmed (returns None)."""
+        with self._lock:
+            if digest in self._exported:
+                return self._loaded.get(digest)
+            self._exported.add(digest)
+        try:
+            import jax
+            from jax import export as jax_export
+
+            exported = jax_export.export(jitted)(*args)
+            atomic_write_bytes(self._entry_path(kind, digest),
+                               exported.serialize())
+            fn = jax.jit(exported.call)
+            self._count("aot_exports")
+            with self._lock:
+                self._loaded[digest] = fn
+            return fn
+        except Exception:  # noqa: BLE001 — persistence is optional
+            self._count("aot_export_errors")
+            with self._lock:
+                self._loaded[digest] = None
+            return None
+
+
+class _Program:
+    """A jitted program factory product with an AOT escape hatch.
+
+    Transparent while no cache is active: ``__call__`` forwards to the
+    original jitted callable (same object, same executables — the
+    default path is untouched). With an active cache, calls route
+    through the persisted-entry protocol. Instances live inside the
+    factories' lru caches, so per-(statics) load state persists across
+    calls exactly like the jitted wrappers they replace.
+    """
+
+    __slots__ = ("kind", "statics", "jitted", "_digests")
+
+    def __init__(self, kind: str, statics: tuple, jitted: Callable):
+        self.kind = kind
+        self.statics = statics
+        self.jitted = jitted
+        # per-avals digest memo (tracing shaped_abstractify over big
+        # pytrees is cheap but not free; call shapes per program are
+        # few) — keyed by the active cache id so a swapped cache
+        # re-resolves
+        self._digests: Dict[Tuple[int, str], str] = {}
+
+    def _digest(self, cache: AotCache, args) -> str:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        quick = (id(cache), str(treedef),
+                 tuple((tuple(x.shape) if hasattr(x, "shape") else (),
+                        str(getattr(x, "dtype", type(x).__name__)))
+                       for x in leaves))
+        key = (id(cache), hashlib.sha256(
+            repr(quick).encode()).hexdigest())
+        got = self._digests.get(key)
+        if got is None:
+            got = _avals_digest(self.kind, self.statics, args)
+            self._digests[key] = got
+        return got
+
+    def __call__(self, *args):
+        cache = _ACTIVE
+        if cache is None:
+            return self.jitted(*args)
+        digest = self._digest(cache, args)
+        fn = cache.lookup(self.kind, digest)
+        if fn is None and not cache.known_bad(digest):
+            cache._count("aot_misses")
+            fn = cache.export(self.kind, digest, self.jitted, args)
+        if fn is not None:
+            try:
+                return fn(*args)
+            except Exception:  # noqa: BLE001 — degrade to a warm miss
+                # the payload deserialized (or exported) but will not
+                # run here (stale runtime, unregistered custom call):
+                # pin this entry to the traced original and keep serving
+                cache.discard(digest)
+        return self.jitted(*args)
+
+
+def aot_program(kind: str, statics: tuple,
+                jitted: Callable) -> Callable:
+    """Wrap a freshly built jitted program for the factories: returns a
+    ``_Program`` that is a pass-through until a cache is activated."""
+    return _Program(kind, statics, jitted)
+
+
+# ---- activation ----
+
+
+def active_cache() -> Optional[AotCache]:
+    return _ACTIVE
+
+
+def activate(path: str) -> AotCache:
+    """Install (or reuse) the process-wide AOT cache at ``path``.
+    Idempotent for a repeated path; a different path replaces the
+    active cache (loaded executables of the old one are dropped with
+    it)."""
+    global _ACTIVE
+    with _LOCK:
+        if _ACTIVE is not None and _ACTIVE.path == str(path):
+            return _ACTIVE
+        cache = AotCache(path)
+        _ACTIVE = cache
+        return cache
+
+
+def deactivate() -> None:
+    """Remove the active cache: factories fall back to their traced
+    originals (tests; the stale-cache recovery path keeps serving from
+    memory but stops touching disk)."""
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+def resolve_aot_dir(setting: Optional[str]) -> Optional[str]:
+    """Map a config/CLI setting to a cache dir or None (disabled).
+    ``None`` follows the ``RIFRAF_TPU_AOT_CACHE`` env var (unset or
+    empty = disabled; ``default`` = the fingerprinted default dir);
+    ``"off"`` disables explicitly; anything else is the directory."""
+    if setting is None:
+        setting = os.environ.get("RIFRAF_TPU_AOT_CACHE", "")
+    if not setting or setting == "off":
+        return None
+    if setting == "default":
+        return default_aot_cache_dir()
+    return str(setting)
+
+
+def activate_from_env() -> Optional[AotCache]:
+    """Env-gated activation (bench, offline sweeps): installs the cache
+    named by ``RIFRAF_TPU_AOT_CACHE`` when set."""
+    d = resolve_aot_dir(None)
+    return activate(d) if d else None
+
+
+def clear_aot_cache() -> int:
+    """Stale-runtime recovery hook (engine.driver.recover_stale_cache):
+    drop the active cache's persisted entries AND the default dir's (a
+    process that never activated still must not leave poisoned entries
+    for the next one). Never raises."""
+    n = 0
+    try:
+        cache = _ACTIVE
+        if cache is not None:
+            n += cache.clear()
+            if cache.path != default_aot_cache_dir():
+                n += clear_cache_dir(default_aot_cache_dir())
+        else:
+            n += clear_cache_dir(default_aot_cache_dir())
+    except Exception:  # noqa: BLE001 — recovery must never raise
+        pass
+    return n

@@ -24,6 +24,25 @@ class QueueFullError(ServeError):
     code = "queue_full"
 
 
+class SheddedError(ServeError):
+    """Deadline-aware load shedding (``ServeConfig.shed``): at
+    admission the server estimated that queued work ahead of this
+    request would consume its whole deadline budget, so it was shed
+    immediately with a ``retry_after_s`` hint instead of being queued
+    to time out. Unlike ``QueueFullError`` (a hard capacity cliff),
+    shedding is proportional: requests with generous deadlines are
+    still admitted while doomed ones are refused the moment they
+    arrive — under sustained overload the server degrades to a
+    predictable admitted-availability instead of timing everything
+    out."""
+
+    code = "shedded"
+
+    def __init__(self, message: str = "", retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class DeadlineExceededError(ServeError):
     """The request's deadline passed before it could be dispatched."""
 

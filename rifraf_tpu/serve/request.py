@@ -95,6 +95,13 @@ class ServeConfig:
     # unhealthy, fails everything outstanding, and rejects new submits
     max_restarts: int = 3
     restart_backoff_s: float = 0.05
+    # a crash after this long of clean running RESETS the restart
+    # budget (and with it the exponential backoff): a worker that
+    # crashes once an hour is a transient, not a crash loop, and must
+    # neither wait minutes to restart nor creep toward the unhealthy
+    # cap. Only an actual loop — crashes closer together than this —
+    # accumulates
+    restart_backoff_reset_s: float = 30.0
     # degradation ladder: per-request retry budget across the rungs
     # (segment-packed -> whole-block batch -> per-request fallback); 2
     # covers the full descent
@@ -159,6 +166,49 @@ class ServeConfig:
     # ``mesh`` (shard ONE program over devices, or run one program PER
     # device — not both)
     n_workers: int = 1
+    # --- elastic fleet (queue-driven autoscaling) ---
+    # max_workers == 0 (default) disables: the fleet is the fixed
+    # n_workers above. With max_workers > 0 the supervisor scales the
+    # worker count between max(1, min_workers) and max_workers against
+    # queue-depth and time-in-queue signals; scale-down is a graceful
+    # drain (the chosen worker finishes its in-flight burst, requeues
+    # nothing, resolves every future, then retires). Parked/quarantined
+    # slots never count toward the target. Mutually exclusive with
+    # ``mesh`` like n_workers
+    min_workers: int = 0
+    max_workers: int = 0
+    # scale UP when pending work exceeds this many flushes per active
+    # worker ...
+    scale_up_depth: int = 2
+    # ... or when the dispatch-time queue-wait EWMA exceeds this while
+    # work is pending
+    scale_up_wait_s: float = 1.0
+    # scale DOWN one worker after the fleet has been fully idle (no
+    # queued work, no busy worker) this long
+    scale_down_idle_s: float = 2.0
+    # min seconds between scale operations (one step per cooldown)
+    scale_cooldown_s: float = 0.5
+
+    # --- admission control (deadline-aware load shedding) ---
+    # with shed on, submit() estimates the queue service time ahead of
+    # a deadline-carrying request (outstanding requests x service-time
+    # EWMA / active workers) and raises SheddedError — with a
+    # retry-after hint — when the estimate exceeds the deadline budget:
+    # doomed work is refused at the door instead of timing out in the
+    # queue. Off by default (requests then ride the binary
+    # QueueFullError backpressure only); requests without deadlines are
+    # never shed
+    shed: bool = False
+
+    # --- AOT executable persistence (serve.aot) ---
+    # persisted-executable cache dir: a cold process deserializes the
+    # warmed bucket grid's exported programs instead of re-tracing.
+    # None follows the RIFRAF_TPU_AOT_CACHE env var (unset/empty =
+    # disabled), "off" disables, "default" uses the machine-
+    # fingerprinted default dir, anything else is the directory itself.
+    # Activation is process-wide (like the persistent XLA compilation
+    # cache): offline sweeps in the same process share the entries
+    aot_cache: Optional[str] = None
 
     # --- durability ---
     # write-ahead completion hook: called as journal(response) from the
@@ -215,6 +265,11 @@ class Request:
     future: Future = field(default_factory=Future)
     # degradation-ladder retry budget consumed so far (worker-owned)
     retries: int = 0
+    # perf_counter when a worker first picked the request up (pack
+    # time): queue-wait = t_dispatch - t_submit feeds the elastic
+    # scale-up signal; service = resolve - t_dispatch feeds the
+    # shed estimator
+    t_dispatch: Optional[float] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -55,6 +55,7 @@ from .errors import (
     ServeError,
     ServerClosedError,
     ServerUnhealthyError,
+    SheddedError,
     WaitTimeoutError,
     WorkerCrashError,
 )
@@ -95,9 +96,36 @@ class ConsensusServer:
                 "n_workers > 1 is the per-device fleet; configure mesh "
                 "OR n_workers, not both"
             )
+        # elastic fleet: max_workers > 0 turns autoscaling on; the
+        # initial size is n_workers clamped into the elastic bounds
+        cfg = self.config
+        self._elastic = cfg.max_workers > 0
+        if self._elastic:
+            if cfg.mesh is not None:
+                raise ValueError(
+                    "elastic workers (max_workers > 0) are the "
+                    "per-device fleet; configure mesh OR elastic "
+                    "workers, not both"
+                )
+            if cfg.max_workers < max(1, cfg.min_workers):
+                raise ValueError(
+                    f"max_workers ({cfg.max_workers}) < min_workers "
+                    f"({cfg.min_workers})"
+                )
+        n0 = max(1, cfg.n_workers)
+        if self._elastic:
+            n0 = min(max(n0, max(1, cfg.min_workers)), cfg.max_workers)
+        # AOT executable persistence: activating installs the
+        # process-wide persisted-program cache the factories consult
+        # (serve.aot) — a restarted process loads the warmed grid's
+        # serialized executables instead of re-tracing
+        from .aot import activate as _aot_activate
+        from .aot import resolve_aot_dir
+
+        aot_dir = resolve_aot_dir(cfg.aot_cache)
+        self.aot = _aot_activate(aot_dir) if aot_dir else None
         self._workers: List[Worker] = [
-            self._make_worker(i) for i in range(
-                max(1, self.config.n_workers))
+            self._make_worker(i) for i in range(n0)
         ]
         self._ids = itertools.count()
         self._closed = False
@@ -117,6 +145,16 @@ class ConsensusServer:
         self._worker_restarts = 0
         self._batcher_restarts = 0
         self._last_stall_beat: Dict[int, float] = {}
+        # backoff reset (restart_backoff_reset_s): a crash after a
+        # sustained healthy period forgives the restart history
+        self._last_crash = time.perf_counter()
+        # elastic slot lifecycle: draining = scale-down in progress
+        # (worker finishing its burst); retired = drained and gone (the
+        # slot is reusable by a later scale-up). Disjoint from _parked.
+        self._draining: set = set()
+        self._retired: set = set()
+        self._last_scale = time.perf_counter()
+        self._last_active = time.perf_counter()
         if start:
             self.start()
 
@@ -133,7 +171,7 @@ class ConsensusServer:
         cfg = self.config
         device = None
         burst_limit = None
-        if cfg.n_workers > 1:
+        if cfg.n_workers > 1 or cfg.max_workers > 1:
             import jax
 
             devs = jax.devices()
@@ -211,10 +249,15 @@ class ConsensusServer:
         if self._batcher_thread is not None:
             self._admit_q.put(_SHUTDOWN)
             self._batcher_thread.join(remaining())
-            # one STOP per worker: each sentinel terminates exactly one
-            # consumer of the shared flush queue
-            for _ in self._workers:
-                self._flush_q.put(STOP)
+            # one STOP per LIVE worker: each sentinel terminates exactly
+            # one consumer of the shared flush queue. Retired/parked
+            # slots have no consumer, and a worker draining for
+            # scale-down exits on its own — if it grabs a STOP first
+            # that still just ends it, and a leftover sentinel in an
+            # empty queue is inert
+            for wt in self._worker_threads:
+                if wt is not None and wt.is_alive():
+                    self._flush_q.put(STOP)
             for wt in self._worker_threads:
                 if wt is not None:
                     wt.join(remaining())
@@ -292,6 +335,22 @@ class ConsensusServer:
                 f"{info.max_len}) exceeds hard limits "
                 f"({cfg.max_reads} reads, len {cfg.max_len})"
             )
+        # deadline-aware load shedding: refuse a request whose deadline
+        # the queue ahead of it would already consume, with a
+        # retry-after hint, instead of queueing it to time out.
+        # Deadline-free requests are never shed (nothing to miss), and
+        # an un-seeded estimator admits everything — shedding needs
+        # evidence, not priors
+        if cfg.shed and deadline_ms is not None:
+            est = self._estimated_wait_s()
+            budget = deadline_ms / 1e3
+            if est is not None and est > budget:
+                self.stats.count("shedded")
+                raise SheddedError(
+                    f"estimated queue service time {est:.3f}s exceeds "
+                    f"the {budget:.3f}s deadline budget",
+                    retry_after_s=max(0.0, est - budget),
+                )
         # the admit fault site: after validation, before the queue — an
         # injected error here reaches the CALLER, like any admission
         # rejection
@@ -381,14 +440,31 @@ class ConsensusServer:
             try:
                 self._check_batcher()
                 self._check_worker()
+                self._elastic_tick()
             except Exception:  # noqa: BLE001 — the watchdog must live
                 self.stats.count("supervisor_errors")
+
+    def _note_crash(self) -> None:
+        """Crash bookkeeping with backoff reset: a crash arriving after
+        ``restart_backoff_reset_s`` of clean running forgives the
+        restart history — the exponential backoff and the unhealthy cap
+        measure crash LOOPS, not isolated transients spread over
+        hours."""
+        now = time.perf_counter()
+        if (now - self._last_crash
+                >= self.config.restart_backoff_reset_s
+                and (self._worker_restarts or self._batcher_restarts)):
+            self._worker_restarts = 0
+            self._batcher_restarts = 0
+            self.stats.count("backoff_resets")
+        self._last_crash = now
 
     def _check_batcher(self) -> None:
         bt = self._batcher_thread
         if bt is None or bt.is_alive():
             return
         self.stats.count("batcher_crashes")
+        self._note_crash()
         if self._batcher_restarts >= self.config.max_restarts:
             self._declare_unhealthy()
             return
@@ -408,6 +484,24 @@ class ConsensusServer:
     def _check_worker_slot(self, i: int) -> None:
         wt = self._worker_threads[i]
         w = self._workers[i]
+        if i in self._retired:
+            return
+        if i in self._draining:
+            if wt is not None and wt.is_alive():
+                return  # still finishing its in-flight burst
+            # thread gone: either a clean drain (w.drained) or a crash
+            # mid-final-burst. Either way the slot retires — it was
+            # being removed — but a crash's in-flight flushes re-enter
+            # the queue for the rest of the fleet like any crash
+            # recovery (no restart, no budget)
+            self._draining.discard(i)
+            self._retired.add(i)
+            self._worker_threads[i] = None
+            self.stats.count("scale_down_retired")
+            if not w.drained:
+                self.stats.count("worker_crashes")
+                self._requeue_crashed(w.take_inflight())
+            return
         if i in self._parked:
             # a restarted worker whose golden probe failed: no thread
             # is running, and that is NOT a crash — re-probe (rate
@@ -435,6 +529,7 @@ class ConsensusServer:
         # The restart budget is FLEET-WIDE — a crash loop on any device
         # exhausts it, exactly like the single-worker server.
         self.stats.count("worker_crashes")
+        self._note_crash()
         crashed = w.take_inflight()
         if self._worker_restarts >= self.config.max_restarts:
             self._declare_unhealthy(crashed)
@@ -495,6 +590,102 @@ class ConsensusServer:
                                  len(retryable))
                 for r in retryable:
                     self._flush_q.put(Flush("fallback", [r], 2))
+
+    # ---- elastic fleet (supervisor thread) ----
+
+    def _active_slots(self) -> List[int]:
+        """Worker slots currently serving traffic: thread running, not
+        parked (failed probe), not draining (scale-down in progress),
+        not retired. This is the population the elastic targets count —
+        a parked slot is capacity the fleet does NOT have."""
+        return [
+            i for i in range(len(self._workers))
+            if i not in self._parked
+            and i not in self._draining
+            and i not in self._retired
+            and self._worker_threads[i] is not None
+            and self._worker_threads[i].is_alive()
+        ]
+
+    def _estimated_wait_s(self) -> Optional[float]:
+        """Expected queue service time for a request admitted NOW:
+        outstanding work times the per-request service EWMA, divided
+        across the active fleet. None until the first completion has
+        seeded the estimator (an un-seeded server never sheds)."""
+        service = self.stats.service_estimate()
+        if service is None:
+            return None
+        with self._outstanding_lock:
+            n_out = len(self._outstanding)
+        return n_out * service / max(1, len(self._active_slots()))
+
+    def _elastic_tick(self) -> None:
+        """One autoscaling decision: grow on queue pressure (depth or
+        time-in-queue), drain the highest slot after sustained idleness,
+        never outside [min_workers or 1, max_workers], at most one
+        resize per cooldown window."""
+        if not self._elastic or self._closed or self._unhealthy:
+            return
+        cfg = self.config
+        now = time.perf_counter()
+        active = self._active_slots()
+        n = len(active)
+        depth = (self._admit_q.qsize() + self._batcher.depth()
+                 + self._flush_q.qsize())
+        if depth > 0 or any(self._workers[i].busy for i in active):
+            self._last_active = now
+        if now - self._last_scale < cfg.scale_cooldown_s:
+            return
+        lo = max(1, cfg.min_workers)
+        wait = self.stats.queue_wait_estimate()
+        pressed = depth > 0 and (
+            depth > cfg.scale_up_depth * max(1, n)
+            or (wait is not None and wait > cfg.scale_up_wait_s)
+        )
+        # the ceiling counts PROVISIONED slots (parked and draining
+        # included), not just active ones: a fleet whose recruits keep
+        # failing the golden probe must park at max_workers slots and
+        # stop, not mint parked workers forever
+        n_prov = len(self._workers) - len(self._retired)
+        if n_prov < cfg.max_workers and (pressed or n < lo):
+            self._scale_up()
+            self._last_scale = now
+        elif (n > lo and depth == 0
+              and now - self._last_active >= cfg.scale_down_idle_s):
+            self._scale_down(max(active))
+            self._last_scale = now
+
+    def _scale_up(self) -> None:
+        """Add one worker: reuse the lowest retired slot if any, else
+        append a new one. The recruit passes the golden probe before
+        joining the round-robin when the integrity layer is on — a bad
+        chip parks instead of serving wrong answers (same contract as a
+        post-crash restart)."""
+        if self._retired:
+            i = min(self._retired)
+            self._retired.discard(i)
+        else:
+            i = len(self._workers)
+            self._workers.append(None)  # placed just below
+            self._worker_threads.append(None)
+        w = self._make_worker(i)
+        self._workers[i] = w
+        self.stats.count("scale_up_events")
+        if self._integrity and not w.golden_probe():
+            self._worker_threads[i] = None
+            self._parked.add(i)
+            return
+        self._worker_threads[i] = self._spawn_worker(i)
+
+    def _scale_down(self, i: int) -> None:
+        """Begin a graceful drain of slot ``i``: the worker finishes
+        whatever burst it already holds, requeues nothing, resolves
+        every future it owns, then exits its loop on its own — the
+        supervisor retires the slot once the thread is gone
+        (``_check_worker_slot``)."""
+        self._workers[i].draining = True
+        self._draining.add(i)
+        self.stats.count("scale_down_events")
 
     def _declare_unhealthy(self,
                            crashed: Sequence[Flush] = ()) -> None:
@@ -573,21 +764,33 @@ class ConsensusServer:
         fault plan's fire accounting when faults are configured."""
         bt = self._batcher_thread
         now = time.perf_counter()
-        alive = [bool(wt is not None and wt.is_alive())
-                 for wt in self._worker_threads]
+        # retired slots are capacity the fleet gave BACK (elastic
+        # scale-down); they are not dead workers, so every fleet rollup
+        # here excludes them
+        live_idx = [i for i in range(len(self._workers))
+                    if i not in self._retired]
+        alive = {
+            i: bool(self._worker_threads[i] is not None
+                    and self._worker_threads[i].is_alive())
+            for i in live_idx
+        }
         out = {
             "healthy": not (self._unhealthy or self._closed),
             "closed": self._closed,
             "unhealthy": self._unhealthy,
             "batcher_alive": bool(bt is not None and bt.is_alive()),
-            # fleet semantics: alive means EVERY worker thread is
-            # running; busy means any of them is; the flush age is the
-            # freshest heartbeat (per-worker detail in "workers")
-            "worker_alive": all(alive),
-            "worker_busy": any(w.busy for w in self._workers),
+            # fleet semantics: alive means EVERY (non-retired) worker
+            # thread is running; busy means any of them is; the flush
+            # age is the freshest heartbeat (per-worker detail in
+            # "workers")
+            "worker_alive": all(alive.values()) if alive else False,
+            "worker_busy": any(self._workers[i].busy
+                               for i in live_idx),
             "last_flush_age_s": round(
-                now - max(w.last_beat for w in self._workers), 3),
-            "n_workers": len(self._workers),
+                now - max(self._workers[i].last_beat
+                          for i in live_idx), 3
+            ) if live_idx else None,
+            "n_workers": len(live_idx),
             "worker_restarts": self._worker_restarts,
             "batcher_restarts": self._batcher_restarts,
             "retry_ladder": self.stats.ladder(),
@@ -596,14 +799,38 @@ class ConsensusServer:
         if len(self._workers) > 1:
             out["workers"] = [
                 {
+                    "slot": i,
                     "alive": alive[i],
-                    "busy": w.busy,
-                    "last_flush_age_s": round(now - w.last_beat, 3),
-                    "device": str(w.device) if w.device is not None
-                    else None,
+                    "busy": self._workers[i].busy,
+                    "last_flush_age_s": round(
+                        now - self._workers[i].last_beat, 3),
+                    "device": str(self._workers[i].device)
+                    if self._workers[i].device is not None else None,
                 }
-                for i, w in enumerate(self._workers)
+                for i in live_idx
             ]
+        if self._elastic:
+            out["elastic"] = {
+                "min_workers": max(1, self.config.min_workers),
+                "max_workers": self.config.max_workers,
+                "active_workers": len(self._active_slots()),
+                "draining": sorted(self._draining),
+                "retired": sorted(self._retired),
+                "scale_up_events": self.stats.get("scale_up_events"),
+                "scale_down_events":
+                    self.stats.get("scale_down_events"),
+                "backoff_resets": self.stats.get("backoff_resets"),
+            }
+        if self.config.shed:
+            est = self._estimated_wait_s()
+            out["shed"] = {
+                "enabled": True,
+                "shedded": self.stats.get("shedded"),
+                "estimated_wait_s": round(est, 4)
+                if est is not None else None,
+            }
+        if self.aot is not None:
+            out["aot"] = self.aot.snapshot()
         if self._integrity:
             out["integrity"] = {
                 "guard": self.config.guard,

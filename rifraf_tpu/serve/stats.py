@@ -40,10 +40,53 @@ class ServerStats:
         self._lane_slots = 0
         self._model_bytes = 0.0
         self._declines: Dict[str, int] = {}
+        # load estimators (elastic scaling + admission control):
+        # exponentially weighted means of per-request service seconds
+        # (dispatch -> resolve) and queue-wait seconds (submit ->
+        # dispatch). None until the first observation
+        self._service_ewma: Optional[float] = None
+        self._queue_wait_ewma: Optional[float] = None
+
+    # EWMA smoothing for the load estimators: heavy enough to ride out
+    # micro-batch size jitter, light enough to track a load shift
+    # within a few dozen requests
+    EWMA_ALPHA = 0.2
 
     def count(self, name: str, k: int = 1) -> None:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + k
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def note_service(self, seconds: float) -> None:
+        """One request's service time (first pickup by a worker to
+        resolution) into the shed estimator's EWMA."""
+        with self._lock:
+            prev = self._service_ewma
+            self._service_ewma = (
+                seconds if prev is None
+                else prev + self.EWMA_ALPHA * (seconds - prev)
+            )
+
+    def note_queue_wait(self, seconds: float) -> None:
+        """One request's time-in-queue (submit to first worker pickup)
+        into the elastic scale-up signal's EWMA."""
+        with self._lock:
+            prev = self._queue_wait_ewma
+            self._queue_wait_ewma = (
+                seconds if prev is None
+                else prev + self.EWMA_ALPHA * (seconds - prev)
+            )
+
+    def service_estimate(self) -> Optional[float]:
+        with self._lock:
+            return self._service_ewma
+
+    def queue_wait_estimate(self) -> Optional[float]:
+        with self._lock:
+            return self._queue_wait_ewma
 
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
@@ -156,6 +199,11 @@ class ServerStats:
                     self._useful_lanes / self._lane_slots, 4
                 ) if self._lane_slots else None,
                 "model_gb": round(self._model_bytes / 1e9, 3),
+                "service_ewma_ms": round(self._service_ewma * 1e3, 3)
+                if self._service_ewma is not None else None,
+                "queue_wait_ewma_ms": round(
+                    self._queue_wait_ewma * 1e3, 3
+                ) if self._queue_wait_ewma is not None else None,
                 "latency_ms": self._percentiles(),
                 "declines": dict(self._declines),
                 "timers": self.timers.to_dict(),

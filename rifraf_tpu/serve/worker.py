@@ -172,6 +172,13 @@ class Worker:
         self.last_beat = time.perf_counter()
         self.busy = False
         self.inflight: List[Flush] = []
+        # graceful drain (elastic scale-down): with ``draining`` set the
+        # loop finishes its current burst, takes nothing further off the
+        # shared flush queue, and exits; ``drained`` marks the CLEAN
+        # exit — a dead draining thread without it crashed mid-burst and
+        # the supervisor recovers its in-flight flushes like any crash
+        self.draining = False
+        self.drained = False
 
     def _heartbeat(self, *_ignored) -> None:
         self.last_beat = time.perf_counter()
@@ -232,6 +239,14 @@ class Worker:
         return plan, packs
 
     def _pack(self, flush: Flush):
+        # first worker pickup: stamp dispatch time and feed the load
+        # estimators (queue-wait drives elastic scale-up; the dispatch
+        # stamp anchors the service-time EWMA the shed door uses)
+        t_pick = time.perf_counter()
+        for r in flush.requests:
+            if r.t_dispatch is None:
+                r.t_dispatch = t_pick
+                self.stats.note_queue_wait(t_pick - r.t_submit)
         if flush.kind != "batch":
             return flush, None
         self.faults.fire("pack")
@@ -427,6 +442,10 @@ class Worker:
         )
         if resolve_future(req, response, self.stats):
             self.stats.observe_latency(lat)
+            self.stats.note_service(
+                time.perf_counter()
+                - (req.t_dispatch if req.t_dispatch is not None
+                   else req.t_submit))
             self.stats.count("completed")
             if self.config.journal is not None:
                 # write-ahead completion record; a broken journal must
@@ -608,10 +627,24 @@ class Worker:
         # (BaseException) the supervisor reads it via take_inflight()
         self.inflight = []
 
+    # how long the consumer blocks per queue poll: short enough that a
+    # drain request is noticed promptly, long enough to stay cheap for
+    # an idle single-worker server
+    POLL_S = 0.05
+
     def run_loop(self, flush_q: Queue) -> None:
         stop = False
         while not stop:
-            item = flush_q.get()
+            if self.draining:
+                # graceful drain: the in-flight burst (if any) finished
+                # on the previous iteration and nothing further is
+                # taken — queued flushes stay for the rest of the fleet
+                self.drained = True
+                return
+            try:
+                item = flush_q.get(timeout=self.POLL_S)
+            except Empty:
+                continue
             self._heartbeat()
             if item is STOP:
                 break

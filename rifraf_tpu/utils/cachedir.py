@@ -59,6 +59,38 @@ def is_stale_cache_error(err) -> bool:
     return "FAILED_PRECONDITION" in msg and "AOT" in msg
 
 
+def default_aot_cache_dir() -> str:
+    """The default AOT-executable cache directory (serve.aot): a
+    machine-fingerprinted sibling of the XLA compilation cache.
+    ``RIFRAF_TPU_AOT_CACHE`` overrides it with an explicit path (or
+    disables with ``off``/empty — the caller checks that before asking
+    for a default)."""
+    return machine_cache_dir(
+        os.path.expanduser("~/.cache/rifraf_tpu_aot")
+    )
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically (tmp file + rename in the
+    same directory), creating parent directories. A reader — another
+    process deserializing AOT entries mid-write — sees either the old
+    file or the complete new one, never a torn payload."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+    finally:
+        try:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def clear_cache_dir(path) -> int:
     """Drop every persistent-cache entry under ``path`` (files only; the
     directory and any subdirectories stay, so a configured cache dir

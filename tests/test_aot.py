@@ -1,0 +1,300 @@
+"""AOT executable persistence (serve.aot): entry keys, the
+export/load round trip, failure degradation, and cache clearing.
+
+The in-process tests wrap small jitted functions directly — the
+protocol under test is the cache's, not the consensus programs'. The
+fresh-process bit-identity smoke (a cold import of a warm process's
+export) is marked slow; CI's elastic job runs it explicitly.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rifraf_tpu.serve import aot
+from rifraf_tpu.utils.cachedir import atomic_write_bytes
+
+
+@pytest.fixture
+def cache(tmp_path):
+    """An activated AotCache in tmp_path; always deactivated after."""
+    c = aot.activate(str(tmp_path / "aot"))
+    yield c
+    aot.deactivate()
+
+
+def _entries(cache):
+    out = []
+    for root, _dirs, files in os.walk(cache.path):
+        out += [os.path.join(root, f) for f in files
+                if f.endswith(".jaxexp")]
+    return sorted(out)
+
+
+# ------------------------------------------------------------ keying
+
+
+def test_avals_digest_separates_statics_shapes_dtypes():
+    x32 = jnp.zeros((4,), jnp.float32)
+    x16 = jnp.zeros((4,), jnp.bfloat16)
+    y32 = jnp.zeros((8,), jnp.float32)
+    base = aot._avals_digest("k", (1,), (x32,))
+    assert base == aot._avals_digest("k", (1,), (x32,))
+    assert base != aot._avals_digest("k", (2,), (x32,))  # statics
+    assert base != aot._avals_digest("k2", (1,), (x32,))  # kind
+    assert base != aot._avals_digest("k", (1,), (x16,))  # dtype
+    assert base != aot._avals_digest("k", (1,), (y32,))  # shape
+    assert base != aot._avals_digest("k", (1,), (x32, x32))  # tree
+
+
+def test_resolve_aot_dir(monkeypatch, tmp_path):
+    monkeypatch.delenv("RIFRAF_TPU_AOT_CACHE", raising=False)
+    assert aot.resolve_aot_dir(None) is None
+    assert aot.resolve_aot_dir("") is None
+    assert aot.resolve_aot_dir("off") is None
+    assert aot.resolve_aot_dir(str(tmp_path)) == str(tmp_path)
+    assert "rifraf_tpu_aot" in aot.resolve_aot_dir("default")
+    monkeypatch.setenv("RIFRAF_TPU_AOT_CACHE", str(tmp_path))
+    assert aot.resolve_aot_dir(None) == str(tmp_path)
+    monkeypatch.setenv("RIFRAF_TPU_AOT_CACHE", "off")
+    assert aot.resolve_aot_dir(None) is None
+
+
+# ---------------------------------------------------- the round trip
+
+
+def test_program_passthrough_without_cache():
+    aot.deactivate()
+    calls = []
+
+    @jax.jit
+    def f(x):
+        calls.append(1)
+        return x * 2
+
+    prog = aot.aot_program("t", (), f)
+    x = jnp.arange(4.0)
+    np.testing.assert_array_equal(prog(x), f(x))
+    assert aot.active_cache() is None
+
+
+def test_export_then_reload_bit_identical(cache):
+    @jax.jit
+    def f(x):
+        # a while_loop, like the real programs: exercises exportability
+        # beyond straight-line arithmetic
+        def body(c):
+            i, v = c
+            return i + 1, v * 1.5 + 0.25
+
+        return jax.lax.while_loop(lambda c: c[0] < 7, body, (0, x))[1]
+
+    prog = aot.aot_program("t", (7,), f)
+    x = jnp.linspace(-1.0, 1.0, 16)
+    want = np.asarray(f(x))
+
+    got = np.asarray(prog(x))  # miss: runs the exported form
+    np.testing.assert_array_equal(got, want)
+    snap = cache.snapshot()
+    assert snap["aot_misses"] == 1
+    assert snap["aot_exports"] == 1
+    assert len(_entries(cache)) == 1
+
+    # a fresh cache object over the same directory = a cold process:
+    # the entry loads from disk, no re-export, and the result is
+    # bit-identical
+    aot.deactivate()
+    cold = aot.activate(cache.path)
+    prog2 = aot.aot_program("t", (7,), f)
+    np.testing.assert_array_equal(np.asarray(prog2(x)), want)
+    snap = cold.snapshot()
+    assert snap["aot_loads"] == 1
+    assert snap["aot_exports"] == 0
+    assert snap["aot_misses"] == 0
+
+
+def test_second_call_uses_loaded_entry_not_reexport(cache):
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    prog = aot.aot_program("t", (), f)
+    x = jnp.zeros((3,))
+    prog(x)
+    prog(x)
+    snap = cache.snapshot()
+    assert snap["aot_misses"] == 1
+    assert snap["aot_exports"] == 1
+
+
+def test_distinct_shapes_get_distinct_entries(cache):
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    prog = aot.aot_program("t", (), f)
+    prog(jnp.zeros((3,)))
+    prog(jnp.zeros((5,)))
+    assert len(_entries(cache)) == 2
+    assert cache.snapshot()["aot_exports"] == 2
+
+
+# ------------------------------------------------ failure degradation
+
+
+def test_corrupt_payload_degrades_to_warm_miss(cache):
+    @jax.jit
+    def f(x):
+        return x * 3
+
+    prog = aot.aot_program("t", (), f)
+    x = jnp.ones((4,))
+    want = np.asarray(f(x))
+    prog(x)
+    (path,) = _entries(cache)
+
+    atomic_write_bytes(path, b"not a serialized module")
+    aot.deactivate()
+    cold = aot.activate(cache.path)
+    prog2 = aot.aot_program("t", (), f)
+    # the load fails, is counted, and the traced original answers
+    np.testing.assert_array_equal(np.asarray(prog2(x)), want)
+    snap = cold.snapshot()
+    assert snap["aot_load_errors"] == 1
+    assert snap["aot_loads"] == 0
+    # pinned bad: a second call does not retry the load or re-export
+    prog2(x)
+    assert cold.snapshot()["aot_load_errors"] == 1
+    assert cold.snapshot()["aot_exports"] == 0
+
+
+def test_export_failure_counts_and_serves(cache):
+    class Unexportable:
+        """Not a jitted callable: jax.export rejects it, the wrapper
+        must serve through the original anyway."""
+
+        def __call__(self, x):
+            return jnp.asarray(x) + 5
+
+    prog = aot.aot_program("t", (), Unexportable())
+    x = jnp.zeros((2,))
+    np.testing.assert_array_equal(np.asarray(prog(x)),
+                                  np.asarray(x + 5))
+    snap = cache.snapshot()
+    assert snap["aot_export_errors"] == 1
+    assert len(_entries(cache)) == 0
+    # the failed digest is pinned: no repeated export attempts
+    prog(x)
+    assert cache.snapshot()["aot_export_errors"] == 1
+
+
+# -------------------------------------------------------- clearing
+
+
+def test_clear_aot_cache_drops_entries_and_reexports(cache):
+    @jax.jit
+    def f(x):
+        return x - 2
+
+    prog = aot.aot_program("t", (), f)
+    x = jnp.zeros((3,))
+    prog(x)
+    assert len(_entries(cache)) == 1
+    n = aot.clear_aot_cache()
+    assert n >= 1
+    assert len(_entries(cache)) == 0
+    # cleared entries re-export on next first-sight (fresh cache)
+    aot.deactivate()
+    aot.activate(cache.path)
+    prog2 = aot.aot_program("t", (), f)
+    prog2(x)
+    assert len(_entries(cache)) == 1
+
+
+def test_recover_stale_cache_clears_aot(tmp_path, monkeypatch):
+    """The PR-8 stale-libtpu recovery path clears the persisted AOT
+    entries along with the XLA compilation cache."""
+    from rifraf_tpu.engine import driver
+
+    # recovery disables the process-wide compilation cache; restore it
+    # afterwards so the rest of the pytest process keeps its conftest
+    # cache behavior
+    prior_enabled = jax.config.jax_enable_compilation_cache
+    c = aot.activate(str(tmp_path / "aot"))
+    try:
+
+        @jax.jit
+        def f(x):
+            return x + 9
+
+        aot.aot_program("t", (), f)(jnp.zeros((2,)))
+        assert len(_entries(c)) == 1
+        stale = RuntimeError(
+            "FAILED_PRECONDITION: libtpu version mismatch")
+        assert driver.recover_stale_cache(stale)
+        assert len(_entries(c)) == 0
+        # a non-stale error must not touch the cache
+        aot.aot_program("t2", (), f)(jnp.zeros((2,)))
+        assert not driver.recover_stale_cache(
+            RuntimeError("INVALID_ARGUMENT: shape mismatch"))
+        assert len(_entries(c)) == 1
+    finally:
+        aot.deactivate()
+        jax.config.update("jax_enable_compilation_cache",
+                          prior_enabled)
+
+
+# ----------------------------------------- fresh-process smoke (slow)
+
+
+_CHILD = r"""
+import sys
+import jax, jax.numpy as jnp
+import numpy as np
+from rifraf_tpu.serve import aot
+
+mode, cache_dir = sys.argv[1], sys.argv[2]
+
+@jax.jit
+def f(x):
+    def body(c):
+        i, v = c
+        return i + 1, v * 1.125 + 0.03125
+    return jax.lax.while_loop(lambda c: c[0] < 9, body, (0, x))[1]
+
+x = jnp.linspace(-2.0, 2.0, 32)
+if mode == "warm":
+    aot.activate(cache_dir)
+    out = aot.aot_program("t", (9,), f)(x)
+else:  # cold
+    cache = aot.activate(cache_dir)
+    out = aot.aot_program("t", (9,), f)(x)
+    snap = cache.snapshot()
+    assert snap["aot_loads"] == 1, snap
+    assert snap["aot_exports"] == 0, snap
+np.save(sys.argv[3], np.asarray(out))
+"""
+
+
+@pytest.mark.slow
+def test_fresh_process_import_bit_identity(tmp_path):
+    """The CI round-trip contract: a warm process exports, a FRESH
+    process (cold import — no tracing of the original) loads the entry
+    and produces bit-identical output."""
+    cache_dir = str(tmp_path / "aot")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    outs = {}
+    for mode in ("warm", "cold"):
+        out = str(tmp_path / f"{mode}.npy")
+        subprocess.run(
+            [sys.executable, "-c", _CHILD, mode, cache_dir, out],
+            check=True, env=env, timeout=300)
+        outs[mode] = np.load(out)
+    np.testing.assert_array_equal(outs["warm"], outs["cold"])

@@ -1,0 +1,403 @@
+"""Elastic serving: queue-driven autoscaling with graceful drain,
+deadline-aware load shedding, and the supervisor's backoff reset.
+
+Everything here runs the fallback path (batch_max_reads=1 — no
+batch-grid compiles) so the suite exercises the fleet lifecycle, not
+device compilation. The drain/close race and the no-hung-futures
+invariant get explicit regression tests; bit-identity of an elastic
+fleet against the fixed single-worker server rides the scale-up test.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rifraf_tpu.engine.driver import rifraf
+from rifraf_tpu.engine.params import RifrafParams
+from rifraf_tpu.models.errormodel import ErrorModel
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.serve import (
+    ConsensusServer,
+    ServeConfig,
+    SheddedError,
+)
+from rifraf_tpu.serve.worker import Worker
+from rifraf_tpu.sim.sample import sample_sequences
+from rifraf_tpu.utils.phred import phred_to_log_p
+
+SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+
+
+def _cluster(nseqs=3, length=30, seed=0):
+    rng = np.random.default_rng(seed)
+    params = RifrafParams()
+    _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=nseqs, length=length, error_rate=0.02, rng=rng,
+        seq_errors=SEQ_ERRORS,
+    )
+    return [
+        make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                         params.bandwidth, params.scores)
+        for s, p in zip(seqs, phreds)
+    ]
+
+
+def _serve_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("rifraf-serve")]
+
+
+def _elastic_cfg(**kw):
+    """Fallback-path elastic config: fast supervisor, tight scaling
+    thresholds so a handful of requests triggers growth and a short
+    idle triggers drain."""
+    kw.setdefault("batch_max_reads", 1)
+    kw.setdefault("max_wait_ms", 5.0)
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("supervise_interval_s", 0.02)
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 3)
+    kw.setdefault("scale_up_depth", 1)
+    kw.setdefault("scale_cooldown_s", 0.02)
+    kw.setdefault("scale_down_idle_s", 0.2)
+    return ServeConfig(**kw)
+
+
+def _wait_for(predicate, timeout_s=30.0, poll_s=0.02):
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return predicate()
+
+
+# ------------------------------------------------------- config guards
+
+
+def test_elastic_config_validation():
+    with pytest.raises(ValueError, match="max_workers"):
+        ConsensusServer(_elastic_cfg(min_workers=4, max_workers=2),
+                        start=False)
+
+
+def test_elastic_initial_size_clamped():
+    srv = ConsensusServer(
+        _elastic_cfg(n_workers=8, min_workers=1, max_workers=2),
+        start=False)
+    try:
+        assert len(srv._workers) == 2
+    finally:
+        srv.close()
+    srv = ConsensusServer(
+        _elastic_cfg(n_workers=1, min_workers=2, max_workers=4),
+        start=False)
+    try:
+        assert len(srv._workers) == 2
+    finally:
+        srv.close()
+
+
+# ------------------------------------- scale up, drain down, identity
+
+
+def test_scale_up_then_drain_down_bit_identical():
+    """Queue pressure grows the fleet, sustained idleness drains it
+    back to min_workers (graceful: every future resolves ok), and the
+    elastic results equal the fixed single-worker reference
+    bit-for-bit."""
+    clusters = [_cluster(seed=i) for i in range(6)]
+    srv = ConsensusServer(_elastic_cfg())
+    try:
+        futs = [srv.submit(c) for c in clusters]
+        res = [f.result(timeout=120) for f in futs]
+        assert all(r.ok for r in res)
+        h = srv.health()
+        assert h["elastic"]["scale_up_events"] >= 1
+        assert h["elastic"]["max_workers"] == 3
+
+        # drain back down: active returns to min_workers, drained slots
+        # retire (their threads exit on their own), nothing requeues
+        assert _wait_for(lambda: (
+            srv.health()["elastic"]["active_workers"] == 1
+            and not srv.health()["elastic"]["draining"]
+        ), timeout_s=30)
+        h = srv.health()
+        assert h["elastic"]["scale_down_events"] >= 1
+        assert h["elastic"]["retired"]
+        assert h["outstanding"] == 0
+        # a retired slot is not a dead worker: the fleet is healthy
+        assert h["healthy"] and h["worker_alive"]
+        assert h["worker_restarts"] == 0
+    finally:
+        srv.close()
+    assert not any(t.is_alive() for t in _serve_threads())
+
+    # bit-identity against the fixed single-worker configuration
+    ref = ConsensusServer(_elastic_cfg(min_workers=0, max_workers=0,
+                                       n_workers=1))
+    try:
+        ref_res = [ref.submit(c).result(timeout=120) for c in clusters]
+    finally:
+        ref.close()
+    for a, b in zip(res, ref_res):
+        assert b.ok
+        assert np.array_equal(np.asarray(a.consensus),
+                              np.asarray(b.consensus))
+        assert a.score == b.score
+
+
+def test_scale_up_reuses_retired_slot():
+    """A drained slot's index is recycled by the next scale-up instead
+    of growing the worker list without bound. Driven through the
+    scaling primitives directly — the organic path is covered by
+    test_scale_up_then_drain_down_bit_identical."""
+    srv = ConsensusServer(_elastic_cfg(max_workers=2,
+                                       scale_down_idle_s=60.0))
+    try:
+        srv._scale_up()
+        assert sorted(srv._active_slots()) == [0, 1]
+        srv._scale_down(1)
+        assert _wait_for(
+            lambda: srv.health()["elastic"]["retired"] == [1],
+            timeout_s=30)
+        srv._scale_up()
+        assert sorted(srv._active_slots()) == [0, 1]
+        h = srv.health()
+        assert h["elastic"]["retired"] == []
+        assert h["elastic"]["scale_up_events"] == 2
+        assert len(srv._workers) == 2  # slot 1 was reused, not appended
+        # the recycled fleet still serves
+        assert srv.submit(_cluster(seed=42)).result(timeout=120).ok
+    finally:
+        srv.close()
+    assert not any(t.is_alive() for t in _serve_threads())
+
+
+# --------------------------------------------- drain vs close() race
+
+
+def test_close_racing_drain_resolves_everything_once():
+    """close() arriving while a scale-down drain is in flight must not
+    double-resolve or leak futures: every submitted future resolves
+    exactly once, every thread exits, and the STOP sentinels only go to
+    slots that still have a consumer."""
+    srv = ConsensusServer(_elastic_cfg(scale_down_idle_s=30.0))
+    try:
+        futs = [srv.submit(_cluster(seed=i)) for i in range(4)]
+        for f in futs:
+            assert f.result(timeout=120).ok
+        # force a drain by hand (idle threshold is out of reach) and
+        # close immediately, racing the worker's drain-exit against the
+        # shutdown's STOP fan-out
+        if len(srv._active_slots()) < 2:
+            srv._scale_up()
+        active = srv._active_slots()
+        assert len(active) >= 2
+        srv._scale_down(max(active))
+    finally:
+        srv.close()
+    assert not any(t.is_alive() for t in _serve_threads())
+    assert all(f.done() for f in futs)
+    h = srv.health()
+    assert h["outstanding"] == 0
+    # exactly one resolution per future: any double-resolve attempt
+    # would have been counted by resolve_future
+    assert srv.stats.get("double_resolve") == 0
+
+
+def test_drained_worker_requeues_nothing():
+    """A draining worker finishes its burst and exits without touching
+    the shared queue: queued flushes stay for the rest of the fleet."""
+    srv = ConsensusServer(_elastic_cfg(max_workers=2,
+                                       scale_down_idle_s=30.0))
+    try:
+        futs = [srv.submit(_cluster(seed=i)) for i in range(4)]
+        for f in futs:
+            assert f.result(timeout=120).ok
+        active = srv._active_slots()
+        if len(active) > 1:
+            srv._scale_down(max(active))
+            assert _wait_for(
+                lambda: srv.health()["elastic"]["retired"],
+                timeout_s=30)
+        # the drained slot exited cleanly (no crash recovery ran)
+        assert srv.stats.get("worker_crashes") == 0
+        assert srv.stats.get("ladder_retry_fallback") == 0
+        # remaining capacity still serves
+        assert srv.submit(_cluster(seed=99)).result(timeout=120).ok
+    finally:
+        srv.close()
+    assert not any(t.is_alive() for t in _serve_threads())
+
+
+# ---------------------------------- parked slots vs the elastic target
+
+
+def test_parked_slots_excluded_and_scale_up_parks_on_probe_failure(
+        monkeypatch):
+    """A parked (probe-failed) slot is NOT elastic capacity: the
+    supervisor recruits a replacement, and a recruit that also fails
+    the golden probe parks instead of restart-looping — bounded by
+    max_workers, with zero restart budget spent on recruits."""
+    probe_ok = {"ok": False}
+
+    def fake_probe(self):
+        self._last_probe = time.perf_counter()
+        ok = probe_ok["ok"]
+        self.stats.count("probe_pass" if ok else "probe_fail")
+        if self.scoreboard is not None:
+            was = self.scoreboard.is_quarantined(self.device)
+            self.scoreboard.note_probe(self.device, ok)
+            if ok and was:
+                self.stats.count("device_reinstated")
+        return ok
+
+    monkeypatch.setattr(Worker, "golden_probe", fake_probe)
+    srv = ConsensusServer(_elastic_cfg(
+        guard=True, probe_interval_s=0.01, max_workers=2,
+        faults="fallback:crash:n=1"))
+    try:
+        fut = srv.submit(_cluster())
+        # the injected crash parks slot 0; the supervisor, seeing zero
+        # active workers (< min), recruits slot 1 — whose probe also
+        # fails, so it parks too. Fleet growth stops at max_workers.
+        assert _wait_for(lambda: (
+            srv.health()["integrity"]["parked_workers"] == [0, 1]
+        ), timeout_s=30)
+        h = srv.health()
+        assert h["elastic"]["active_workers"] == 0
+        assert len(srv._workers) == 2  # bounded: no parked-slot minting
+        assert h["worker_restarts"] == 1  # the crash; recruits are free
+        assert not fut.done()  # requeued work waits for a clean probe
+        probe_ok["ok"] = True
+        assert fut.result(timeout=120).ok
+        assert _wait_for(lambda: (
+            srv.health()["integrity"]["parked_workers"] == []
+        ), timeout_s=30)
+    finally:
+        srv.close()
+    assert not any(t.is_alive() for t in _serve_threads())
+
+
+# ------------------------------------------------- backoff forgiveness
+
+
+def test_restart_backoff_resets_after_sustained_health():
+    """A crash after restart_backoff_reset_s of clean running forgives
+    the restart history; a crash inside the window does not."""
+    srv = ConsensusServer(
+        _elastic_cfg(restart_backoff_reset_s=0.05), start=False)
+    try:
+        srv._worker_restarts = 3
+        srv._batcher_restarts = 1
+        srv._last_crash = time.perf_counter()  # crash just happened
+        srv._note_crash()  # inside the window: history stands
+        assert srv._worker_restarts == 3
+        assert srv.stats.get("backoff_resets") == 0
+        srv._last_crash = time.perf_counter() - 1.0  # sustained health
+        srv._note_crash()
+        assert srv._worker_restarts == 0
+        assert srv._batcher_restarts == 0
+        assert srv.stats.get("backoff_resets") == 1
+        assert srv.health()["elastic"]["backoff_resets"] == 1
+    finally:
+        srv.close()
+
+
+def test_supervisor_applies_backoff_reset_on_real_crash():
+    """End to end: one injected crash long after start (reset window
+    tiny) both restarts the worker and logs a backoff reset."""
+    srv = ConsensusServer(_elastic_cfg(
+        min_workers=0, max_workers=0, n_workers=1,
+        restart_backoff_reset_s=0.0,
+        faults="fallback:crash:n=1"))
+    try:
+        srv._worker_restarts = 2  # pretend history from earlier crashes
+        fut = srv.submit(_cluster())
+        assert fut.result(timeout=120).ok
+        assert srv.stats.get("backoff_resets") >= 1
+        # the reset zeroed history BEFORE the restart was counted
+        assert srv.health()["worker_restarts"] == 1
+    finally:
+        srv.close()
+    assert not any(t.is_alive() for t in _serve_threads())
+
+
+# ------------------------------------------------------- load shedding
+
+
+def test_shed_typed_with_retry_after_hint():
+    """Admission sheds a deadline the estimated queue already consumes:
+    typed SheddedError, retry_after_s > 0, counted; deadline-free and
+    generous-deadline requests are admitted."""
+    srv = ConsensusServer(_elastic_cfg(shed=True), start=False)
+    try:
+        # seed the estimator: 10 s of service per request, one request
+        # already outstanding, one active-at-most worker
+        srv.stats.note_service(10.0)
+        srv.submit(_cluster(seed=0))  # no deadline: never shed
+        with pytest.raises(SheddedError) as ei:
+            srv.submit(_cluster(seed=1), deadline_ms=100.0)
+        assert ei.value.code == "shedded"
+        assert ei.value.retry_after_s > 0
+        assert srv.stats.get("shedded") == 1
+        # a generous deadline clears the estimate and is admitted
+        fut = srv.submit(_cluster(seed=2), deadline_ms=60_000.0)
+        assert fut is not None
+        h = srv.health()
+        assert h["shed"]["enabled"]
+        assert h["shed"]["shedded"] == 1
+        assert h["shed"]["estimated_wait_s"] > 0
+    finally:
+        srv.close()
+
+
+def test_shed_disabled_and_unseeded_admit_everything():
+    """shed=False (the default) never sheds; shed=True with no service
+    observations admits everything (no evidence, no refusals)."""
+    srv = ConsensusServer(_elastic_cfg(shed=False), start=False)
+    try:
+        srv.stats.note_service(10.0)
+        srv.submit(_cluster(seed=0))
+        srv.submit(_cluster(seed=1), deadline_ms=1.0)  # not shed
+        assert srv.stats.get("shedded") == 0
+        assert "shed" not in srv.health()
+    finally:
+        srv.close()
+    srv = ConsensusServer(_elastic_cfg(shed=True), start=False)
+    try:
+        srv.submit(_cluster(seed=0), deadline_ms=1.0)  # estimator empty
+        assert srv.stats.get("shedded") == 0
+    finally:
+        srv.close()
+
+
+def test_shed_under_synthetic_overload_keeps_admitted_available():
+    """Under a queue the server cannot clear in time, every rejection
+    is a typed SheddedError and every ADMITTED request still resolves
+    (ok or typed) — availability of the admitted set, no hung
+    futures."""
+    srv = ConsensusServer(_elastic_cfg(
+        shed=True, min_workers=0, max_workers=0, n_workers=1))
+    try:
+        # one real request seeds the service EWMA
+        assert srv.submit(_cluster(seed=0)).result(timeout=120).ok
+        # inflate the estimator so tight deadlines shed deterministically
+        srv.stats.note_service(30.0)
+        admitted, shed = [], 0
+        for i in range(8):
+            try:
+                admitted.append(
+                    srv.submit(_cluster(seed=i), deadline_ms=50.0))
+            except SheddedError:
+                shed += 1
+        assert shed >= 1
+        assert srv.stats.get("shedded") == shed
+        for f in admitted:
+            f.result(timeout=120)  # resolves (ok or typed), never hangs
+    finally:
+        srv.close()
+    assert not any(t.is_alive() for t in _serve_threads())

@@ -116,7 +116,8 @@ def build_e2e_problem(tlen=TLEN, n_reads=N_READS, seed=0, error_rate=0.01):
 
 
 def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
-            device_loop=None, do_score=False, band_dtype=None):
+            device_loop=None, do_score=False, band_dtype=None,
+            input_enc=None):
     """One full consensus; returns (wall_seconds, result)."""
     from rifraf_tpu.engine.driver import rifraf
     from rifraf_tpu.engine.params import RifrafParams
@@ -151,6 +152,8 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
         kw["do_score"] = True
     if band_dtype is not None:
         kw["band_dtype"] = band_dtype
+    if input_enc is not None:
+        kw["input_enc"] = input_enc
     params = RifrafParams(max_iters=max_iters, **kw)
     t0 = time.perf_counter()
     result = rifraf(seqs, phreds=phreds, params=params)
@@ -159,7 +162,8 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
 
 def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
                 max_iters=100, verbose=False, ref_default=False,
-                device_loop=None, do_score=False, band_dtype=None):
+                device_loop=None, do_score=False, band_dtype=None,
+                input_enc=None):
     template, seqs, phreds = build_e2e_problem(tlen, n_reads)
     walls = []
     result = None
@@ -167,7 +171,7 @@ def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
         wall, result = run_e2e(seqs, phreds, bandwidth=bandwidth,
                                max_iters=max_iters, ref_default=ref_default,
                                device_loop=device_loop, do_score=do_score,
-                               band_dtype=band_dtype)
+                               band_dtype=band_dtype, input_enc=input_enc)
         if verbose:
             label = "compile+run" if i == 0 else "warm"
             print(f"  run {i}: {wall:.2f}s ({label})", file=sys.stderr)
@@ -509,6 +513,44 @@ def _precision_mode():
     out["modeled_total_byte_reduction"] = round(
         1.0 - m[2]["bytes"] / m[4]["bytes"], 4
     )
+
+    # --- input encoding (params.input_enc) at the same shape: the full
+    # band_dtype x input_enc matrix of modeled fused-step bytes, so the
+    # two byte levers are reported separately AND combined. "packed"
+    # shrinks only the streamed input tables (2-bit bases + int8 score
+    # planes, ops.encoding): modeled_input_byte_reduction is the table-
+    # term reduction (the honest per-lever number); the per-cell
+    # total_reduction values show how much of the whole step each
+    # combination removes — the packed+bf16 cell is the headline, since
+    # the two levers cut disjoint byte terms.
+    enc_m = {
+        (isz, enc): roofline.fused_mega_model(
+            T1p, K, Npad, C, band_itemsize=isz, input_enc=enc)
+        for isz in (4, 2) for enc in ("f32", "packed")
+    }
+    base = enc_m[(4, "f32")]
+    out["input_encoding"] = {
+        "modeled_input_byte_reduction": round(
+            1.0 - enc_m[(4, "packed")]["tab_bytes"] / base["tab_bytes"],
+            4,
+        ),
+        "input_tab_fraction_of_step": round(
+            base["tab_bytes"] / base["bytes"], 4
+        ),
+        "matrix": {
+            f"band_{'f32' if isz == 4 else 'bf16'}_input_{enc}": {
+                "model_gb": round(mm["bytes"] / 1e9, 4),
+                "total_reduction_vs_f32_f32": round(
+                    1.0 - mm["bytes"] / base["bytes"], 4
+                ),
+            }
+            for (isz, enc), mm in enc_m.items()
+        },
+        # headline: both levers on (disjoint terms: bands vs tables)
+        "modeled_combined_byte_reduction": round(
+            1.0 - enc_m[(2, "packed")]["bytes"] / base["bytes"], 4
+        ),
+    }
     print(json.dumps(out))
 
 

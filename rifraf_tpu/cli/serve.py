@@ -120,6 +120,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "flagged reads' bands (reference), or grow "
                         "each read by its measured band-edge deficit "
                         "(adaptive; smaller settled bands)")
+    p.add_argument("--input-enc", default="f32",
+                   choices=("f32", "packed"),
+                   help="streamed-input encoding: f32 ships score "
+                        "planes exactly; packed packs bases 2-bit and "
+                        "quantizes score planes to int8 for the Pallas "
+                        "kernels (accuracy-gated; the serve device "
+                        "programs themselves stay exact — the value "
+                        "keys program caches and the resume "
+                        "fingerprint, see docs/api.md Input encoding)")
     p.add_argument("--alignment-proposals", action="store_true",
                    help="use the full single-indel proposal pass instead "
                         "of the seeded edits gate")
@@ -206,6 +215,7 @@ def config_from_args(args) -> ServeConfig:
         shed=args.shed,
         band_dtype=args.band_dtype,
         band_growth=args.band_growth,
+        input_enc=args.input_enc,
         guard=args.guard,
         verify_fraction=args.verify_fraction,
         quarantine_threshold=args.quarantine_threshold,
@@ -495,11 +505,17 @@ def _spool_fingerprint(path: str, args, config: ServeConfig) -> str:
             head = fh.read(65536)
     except OSError:
         pass
+    # input_enc folds in only when non-default so spool journals
+    # written before the knob existed stay resumable under f32
+    enc_parts = (
+        ["input_enc", config.input_enc]
+        if config.input_enc != "f32" else []
+    )
     return fingerprint(
         os.path.basename(path), config.scores, args.phred_cap,
         args.deadline_ms, args.max_iters, args.alignment_proposals,
         hashlib.sha256(head).hexdigest(),
-        config.band_dtype, config.band_growth,
+        config.band_dtype, config.band_growth, *enc_parts,
     )
 
 

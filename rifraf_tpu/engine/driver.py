@@ -341,6 +341,7 @@ def realign_rescore(state: RifrafState, params: RifrafParams) -> None:
                 len_bucket=params.len_bucket, mesh=params.mesh,
                 backend=params.backend, band_dtype=params.band_dtype,
                 band_growth=params.band_growth,
+                input_enc=params.input_enc,
             )
         else:
             state.aligner.set_batch(state.batch_seqs)

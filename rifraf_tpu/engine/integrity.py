@@ -250,7 +250,8 @@ def oracle_rescore(cluster, *, max_iters: int = 100, min_dist: int = 15,
                    bandwidth_pvalue: float = 0.1,
                    do_alignment_proposals: bool = False,
                    band_dtype: str = "f32", band_growth: str = "double",
-                   scores=None, bandwidth=None, device=None, impl=None):
+                   scores=None, bandwidth=None, device=None, impl=None,
+                   input_enc: str = "f32"):
     """Recompute one cluster's consensus on the independent oracle path:
     the per-cluster device loop in the batched path's exact algorithmic
     configuration (the sweep-vs-driver equality contract,
@@ -277,6 +278,7 @@ def oracle_rescore(cluster, *, max_iters: int = 100, min_dist: int = 15,
         max_iters=max_iters, min_dist=min_dist,
         bandwidth_pvalue=bandwidth_pvalue, device_loop="on",
         band_dtype=band_dtype, band_growth=band_growth,
+        input_enc=input_enc,
         **extra,
     )
     with oracle_impl(impl):

@@ -132,6 +132,15 @@ class RifrafParams:
     # whose traceback path rides the band wall, by the measured deficit
     # on the 8-row K grid, entering at min(bandwidth, 16)
     band_growth: str = "double"
+    # streamed-input wire format of the Pallas kernels (ops.encoding):
+    # "f32" (default) ships the per-base score planes and read codes
+    # exactly as built — bit-identical; "packed" packs bases 2-bit and
+    # quantizes the four score planes to int8 against per-read
+    # scale/offset pairs, decoded to f32 in-register at VMEM load
+    # (error <= scale/2 per value; accuracy-gated like band_dtype,
+    # docs/api.md "Input encoding"). Pallas-only: the XLA fallback,
+    # panel, and mesh paths keep exact f32 inputs either way.
+    input_enc: str = "f32"
 
 
 def resolve_dtype(dtype) -> np.dtype:
@@ -211,7 +220,9 @@ def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> No
         raise ValueError(
             f"band_dtype must be 'f32' or 'bf16', got {params.band_dtype!r}"
         )
+    from ..ops.encoding import check_input_enc
     from .bandgrowth import check_band_growth
 
     check_band_growth(params.band_growth)
+    check_input_enc(params.input_enc)
     validate_backend(params.backend, params.dtype, params.mesh)

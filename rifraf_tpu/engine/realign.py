@@ -72,7 +72,7 @@ def _bw_hist(bandwidths) -> tuple:
 def _dense_cols(T1p: int, K: int, Npad: int = 0,
                 want_stats: bool = False, impl: str = "split",
                 n_live: int = 0, band_dtype: str = "f32",
-                bw_hist=None) -> int:
+                bw_hist=None, input_enc: str = "f32") -> int:
     """Column block for the fused/dense Pallas dispatches via the shared
     VMEM planner (utils.shapes.plan_cols), recording the block plan and
     modelled HBM traffic so bench/diagnostics can report roofline
@@ -95,23 +95,25 @@ def _dense_cols(T1p: int, K: int, Npad: int = 0,
         if impl == "mega":
             model = roofline.fused_mega_model(T1p, K, Npad, C,
                                               want_stats=want_stats,
-                                              band_itemsize=isz)
+                                              band_itemsize=isz,
+                                              input_enc=input_enc)
         else:
             model = roofline.fused_model(T1p, K, Npad, C,
                                          want_stats=want_stats,
-                                         band_itemsize=isz)
+                                         band_itemsize=isz,
+                                         input_enc=input_enc)
         roofline.record(
             "fused_step", T1p=T1p, K=K, Npad=Npad, C=C, impl=impl,
             vmem_bytes=plan.vmem_bytes, model_bytes=model["bytes"],
             model_ops=model["ops"], want_stats=want_stats,
             lane_occupancy=(n_live / Npad) if n_live else None,
-            band_dtype=band_dtype, bw_hist=bw_hist,
+            band_dtype=band_dtype, bw_hist=bw_hist, input_enc=input_enc,
         )
     return C
 
 
 def _fill_cols(T1p: int, K: int, Npad: int = 0, band_dtype: str = "f32",
-               bw_hist=None) -> int:
+               bw_hist=None, input_enc: str = "f32") -> int:
     """Column block for the forward-only fill+stats dispatch (adapt
     rounds): the fill plan must also hold the int32 move block in VMEM
     (want_moves=True)."""
@@ -123,14 +125,15 @@ def _fill_cols(T1p: int, K: int, Npad: int = 0, band_dtype: str = "f32",
     if Npad:
         f = roofline.fill_model(T1p, K, Npad, C, n_streams=1,
                                 want_moves=True, moves_lanes=Npad,
-                                band_itemsize=_band_itemsize(band_dtype))
-        s = roofline.stats_model(T1p, K, Npad, C)
+                                band_itemsize=_band_itemsize(band_dtype),
+                                input_enc=input_enc)
+        s = roofline.stats_model(T1p, K, Npad, C, input_enc=input_enc)
         roofline.record(
             "fill_stats", T1p=T1p, K=K, Npad=Npad, C=C,
             vmem_bytes=plan.vmem_bytes,
             model_bytes=f["bytes"] + s["bytes"],
             model_ops=f["ops"] + s["ops"],
-            band_dtype=band_dtype, bw_hist=bw_hist,
+            band_dtype=band_dtype, bw_hist=bw_hist, input_enc=input_enc,
         )
     return C
 
@@ -195,7 +198,8 @@ class BatchAligner:
 
     def __init__(self, reads: Sequence[ReadScores], dtype=None,
                  len_bucket: int = 64, mesh=None, backend: str = "auto",
-                 band_dtype: str = "f32", band_growth: str = "double"):
+                 band_dtype: str = "f32", band_growth: str = "double",
+                 input_enc: str = "f32"):
         """`mesh`: an optional jax.sharding.Mesh with a "reads" axis. When
         given, the read axis of every batch array is sharded across the
         mesh, per-read DP fills run on their home devices, and the
@@ -206,7 +210,14 @@ class BatchAligner:
 
         `band_dtype`/`band_growth`: the byte-wall levers (params.
         RifrafParams): HBM store dtype of the DP band tables and the
-        bandwidth-adaptation policy (engine.bandgrowth)."""
+        bandwidth-adaptation policy (engine.bandgrowth).
+
+        `input_enc`: streamed-input wire format of the Pallas kernels
+        ("f32" exact default, "packed" = 2-bit bases + int8-quantized
+        score planes, ops.encoding). Pallas-only: the XLA fallback and
+        panel paths keep exact f32 inputs either way."""
+        from ..ops.encoding import check_input_enc
+
         self.dtype = resolve_dtype(dtype)
         self.len_bucket = int(len_bucket)
         self.mesh = mesh
@@ -216,14 +227,16 @@ class BatchAligner:
                 f"band_dtype must be 'f32' or 'bf16', got {band_dtype!r}"
             )
         check_band_growth(band_growth)
+        check_input_enc(input_enc)
         if mesh is not None:
             # the shard_map wrappers and their psum epilogues compile
-            # against the f32 band layout with uniform doubling; both
-            # levers are single-device (and sweep-fleet) features, so a
-            # mesh silently rides the exact defaults
-            band_dtype, band_growth = "f32", "double"
+            # against the f32 band layout with uniform doubling; all
+            # three levers are single-device (and sweep-fleet) features,
+            # so a mesh silently rides the exact defaults
+            band_dtype, band_growth, input_enc = "f32", "double", "f32"
         self.band_dtype = band_dtype
         self.band_growth = band_growth
+        self.input_enc = input_enc
         # resolved per aligner, not as a process global: cluster-sweep
         # threads pinned to different (possibly heterogeneous) devices
         # must each chunk against their OWN device's HBM
@@ -289,8 +302,11 @@ class BatchAligner:
         self._total = None
         self.edits_seen = None
         self._realign_key = None  # memo key of the last completed realign
-        # Pallas-path state (built lazily; template-independent per batch)
-        self._fill_bufs = None
+        # Pallas-path state (built lazily; template-independent per
+        # batch). Fill buffers key on the input encoding: one process
+        # can interleave packed-encoded aligners with f32 ones (and the
+        # panel path always needs the exact f32 buffers)
+        self._fill_bufs = {}
         self._stage_runners = {}
 
     def _padded_template(self, consensus: np.ndarray) -> np.ndarray:
@@ -414,29 +430,39 @@ class BatchAligner:
         r = np.arange(n)
         return (r // Nlocal) * Npad_local + (r % Nlocal)
 
-    def _ensure_fill_bufs(self):
-        if self._fill_bufs is None:
+    def _ensure_fill_bufs(self, input_enc=None):
+        """Lazily-built per-encoding fill buffers. ``input_enc`` defaults
+        to the aligner's knob; the panel path pins "f32" explicitly (it
+        never decodes in-kernel)."""
+        enc = self.input_enc if input_enc is None else input_enc
+        if enc not in self._fill_bufs:
             import jax
 
             import jax.numpy as jnp
 
             if self.mesh is not None:
+                # mesh forces input_enc="f32" in __init__, so this cache
+                # only ever holds the f32 sharded buffers
                 from ..parallel.sharding import mesh_fill_buffers
 
                 _, Npad_local, _ = self._mesh_npads()
-                self._fill_bufs = jax.block_until_ready(mesh_fill_buffers(
-                    self.mesh, self.batch, Npad_local
-                ))
+                self._fill_bufs[enc] = jax.block_until_ready(
+                    mesh_fill_buffers(self.mesh, self.batch, Npad_local)
+                )
             else:
                 from ..ops.fill_pallas import build_fill_buffers
 
                 Npad = _bucket(self.batch.n_reads, 128)
-                self._fill_bufs = jax.block_until_ready(build_fill_buffers(
-                    self.batch.seq, self.batch.match, self.batch.mismatch,
-                    self.batch.ins, self.batch.dels,
-                    jnp.asarray(self._lengths_host), Npad,
-                ))
-        return self._fill_bufs
+                self._fill_bufs[enc] = jax.block_until_ready(
+                    build_fill_buffers(
+                        self.batch.seq, self.batch.match,
+                        self.batch.mismatch, self.batch.ins,
+                        self.batch.dels,
+                        jnp.asarray(self._lengths_host), Npad,
+                        input_enc=enc,
+                    )
+                )
+        return self._fill_bufs[enc]
 
     def _uniform_geom_host(self, tlen: int):
         """Host-side uniform-frame geometry (fill_pallas.uniform_geometry
@@ -483,7 +509,8 @@ class BatchAligner:
                         want_stats=want_stats, impl=impl,
                         n_live=self.batch.n_reads,
                         band_dtype=self.band_dtype,
-                        bw_hist=_bw_hist(self.bandwidths))
+                        bw_hist=_bw_hist(self.bandwidths),
+                        input_enc=self.input_enc)
         bufs = self._ensure_fill_bufs()
         batch = self._current_batch()
         self.n_forward_fills += 1
@@ -525,6 +552,7 @@ class BatchAligner:
                     want_stats=want_stats, want_moves=want_moves,
                     interpret=_pallas_interpret(), impl=impl,
                     band_dtype=self.band_dtype,
+                    input_enc=self.input_enc,
                 )
             Npad = bufs.seq_T.shape[1]
             slots = np.arange(self.batch.n_reads)
@@ -556,7 +584,8 @@ class BatchAligner:
         # small fraction of the budget; multiple of C
         per_col = 13 * K * Npad * 4
         P = max(C, min(4096, int(self.hbm_budget // per_col)) // C * C)
-        bufs = self._ensure_fill_bufs()
+        # panels never decode in-kernel: always the exact f32 buffers
+        bufs = self._ensure_fill_bufs("f32")
         batch = self._current_batch()
         geom = align_jax.batch_geometry(batch, tlen)
         weights = jnp.ones(self.batch.n_reads, dtype=jnp.float32)
@@ -702,7 +731,8 @@ class BatchAligner:
             and T1 <= DENSE_BLOCK_THRESHOLD
         )
         key = (Tmax, K, use_pallas, do_indels, min_dist, history_cap,
-               stop_on_same, use_edits, impl, seg_pair, self.band_dtype)
+               stop_on_same, use_edits, impl, seg_pair, self.band_dtype,
+               self.input_enc)
         if key in self._stage_runners:
             return self._stage_runners[key]
         bw_dev = jnp.asarray(self.bandwidths)
@@ -712,12 +742,13 @@ class BatchAligner:
             C = _dense_cols(T1p, K, _bucket(n_reads, 128),
                             want_stats=use_edits, impl=impl,
                             n_live=n_reads, band_dtype=self.band_dtype,
-                            bw_hist=_bw_hist(self.bandwidths))
+                            bw_hist=_bw_hist(self.bandwidths),
+                            input_enc=self.input_enc)
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_stage_runner(
                 K, T1p, C, do_indels, min_dist,
                 history_cap, Tmax, stop_on_same, use_edits, impl,
-                self.band_dtype,
+                self.band_dtype, self.input_enc,
             )
             state = (self._ensure_fill_bufs(), lengths_dev, bw_dev, weights)
         else:
@@ -810,7 +841,7 @@ class BatchAligner:
             impl = select_impl(T1p, K)[0]
         key = ("frame", Tmax, K, use_pallas, do_subs, min_dist,
                history_cap, stop_on_same, Kc, T1pc, nrows, ref.bandwidth,
-               seed_gate, impl, self.band_dtype)
+               seed_gate, impl, self.band_dtype, self.input_enc)
         hit = self._stage_runners.get(key)
         if hit is not None and hit[0] is rt:
             return hit[1]
@@ -825,12 +856,13 @@ class BatchAligner:
         if use_pallas:
             C = _dense_cols(T1p, K, _bucket(n_reads, 128), impl=impl,
                             n_live=n_reads, band_dtype=self.band_dtype,
-                            bw_hist=_bw_hist(self.bandwidths))
+                            bw_hist=_bw_hist(self.bandwidths),
+                            input_enc=self.input_enc)
             weights = jnp.ones(n_reads, dtype=jnp.float32)
             base = _pallas_frame_runner(
                 K, T1p, C, True, do_subs, min_dist, history_cap, Tmax,
                 stop_on_same, Kc, T1pc, nrows, rt.do_cins, rt.do_cdel,
-                seed_gate, impl, self.band_dtype,
+                seed_gate, impl, self.band_dtype, self.input_enc,
             )
             read_state = (self._ensure_fill_bufs(), lengths_dev, bw_dev,
                           weights)
@@ -1070,7 +1102,8 @@ class BatchAligner:
         K = self._pallas_K(tlen)
         C = _fill_cols(T1p, K, _bucket(self.batch.n_reads, 128),
                        band_dtype=self.band_dtype,
-                       bw_hist=_bw_hist(self.bandwidths))
+                       bw_hist=_bw_hist(self.bandwidths),
+                       input_enc=self.input_enc)
         bufs = self._ensure_fill_bufs()
         batch = self._current_batch()
         self.n_forward_fills += 1
@@ -1091,7 +1124,7 @@ class BatchAligner:
                 packed = fill_stats_pallas(
                     t_dev, jnp.int32(tlen), bufs, geom, K, T1p, C,
                     interpret=_pallas_interpret(), want_edge=want_edge,
-                    band_dtype=self.band_dtype,
+                    band_dtype=self.band_dtype, input_enc=self.input_enc,
                 )
             Npad = bufs.seq_T.shape[1]
             slots = np.arange(self.batch.n_reads)
@@ -1352,7 +1385,7 @@ def _frame_seed_gates(tmpl, tlen, rt9s, Kc: int, T1pc: int, nrows: int,
 def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
                          history_cap, Tmax, stop_on_same, Kc, T1pc, nrows,
                          do_cins, do_cdel, seed_gate=False, impl="split",
-                         band_dtype="f32"):
+                         band_dtype="f32", input_enc="f32"):
     """Compiled device FRAME stage loop: Pallas read step + codon-engine
     reference tables. step_state = ((FillBuffers, lengths, bandwidths,
     weights), rt_arrays[, skewed rt_arrays]). ``impl`` is the fused-step
@@ -1374,7 +1407,7 @@ def _pallas_frame_runner(K, T1p, C, do_indels, do_subs, min_dist,
         out = fused_tables_auto(
             tmpl, tlen, bufs, geom, weights, K, T1p, C,
             interpret=_pallas_interpret(), impl=impl,
-            band_dtype=band_dtype,
+            band_dtype=band_dtype, input_enc=input_enc,
         )
         base = _add_ref_tables(
             (out["total"], out["sub"], out["ins"], out["del"]),
@@ -1445,7 +1478,7 @@ def _xla_frame_runner(K, T1, Tmax, chunk, n_reads, do_indels, do_subs,
 @functools.lru_cache(maxsize=64)
 def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
                          history_cap, Tmax, stop_on_same, use_edits=False,
-                         impl="split", band_dtype="f32"):
+                         impl="split", band_dtype="f32", input_enc="f32"):
     """Compiled device stage loop over the Pallas fused step, shared
     across aligners of identical shape config. step_state =
     (FillBuffers, lengths, bandwidths, weights). ``impl`` routes each
@@ -1462,7 +1495,7 @@ def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
         out = fused_tables_auto(
             tmpl, tlen, bufs, geom, weights, K, T1p, C,
             want_stats=use_edits, interpret=_pallas_interpret(),
-            impl=impl, band_dtype=band_dtype,
+            impl=impl, band_dtype=band_dtype, input_enc=input_enc,
         )
         base = (out["total"], out["sub"], out["ins"], out["del"])
         if use_edits:

@@ -36,9 +36,11 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-# FASTQ offset-33 printable range '!'..'~' — phreds beyond this cannot
-# round-trip through quality strings and signal corrupt input
-MAX_PHRED = 93
+# FASTQ offset-33 printable range '!'..'~' (Q0..Q93) — phreds outside
+# it cannot round-trip through quality strings and signal corrupt
+# input. The bounds are shared with utils.phred so the conversion and
+# validation layers can never disagree about what a legal score is.
+from ..utils.phred import MAX_PHRED, MIN_PHRED
 
 _VALID_BASES = frozenset(b"ACGTacgt")
 
@@ -151,7 +153,7 @@ def validate_phreds(phred, seq_len: Optional[int] = None, *,
         raise PhredRangeError(
             f"non-finite phred value{_where(name, index, source)}", **ctx)
     lo, hi = float(arr.min()), float(arr.max())
-    if lo < 0:
+    if lo < MIN_PHRED:  # MIN_PHRED = 0: Q0 ('!') is legal FASTQ
         raise PhredRangeError(
             f"phred score cannot be negative (got {lo:g})"
             f"{_where(name, index, source)}", value=lo, **ctx)

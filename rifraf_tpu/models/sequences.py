@@ -202,7 +202,11 @@ class ReadBatch(NamedTuple):
 
     Padding positions carry harmless finite scores; every kernel masks by
     `lengths`. `cins`/`cdel` are all -inf when codon moves are disabled, which
-    uniformly disables those moves in the kernels.
+    uniformly disables those moves in the kernels — and when NO read in the
+    batch carries codon scores (the read path: codon moves live only in the
+    reference alignment), they collapse to a compact ``[N, 1]`` -inf
+    sentinel instead of two dead full-width f32 planes. Consumers must key
+    on ``do_codon_moves`` (or the plane width), not assume ``[N, L]``.
     """
 
     seq: np.ndarray  # int8 [N, L], padded with GAP_INT
@@ -211,9 +215,17 @@ class ReadBatch(NamedTuple):
     mismatch: np.ndarray  # [N, L]
     ins: np.ndarray  # [N, L]
     dels: np.ndarray  # [N, L + 1]
-    cins: np.ndarray  # [N, L] (index i <-> codon_ins_scores[i], valid i <= n-3)
-    cdel: np.ndarray  # [N, L + 1]
+    # [N, L] (index i <-> codon_ins_scores[i], valid i <= n-3), or the
+    # [N, 1] -inf sentinel when no read has codon scores
+    cins: np.ndarray
+    cdel: np.ndarray  # [N, L + 1], or the [N, 1] -inf sentinel
     bandwidth: np.ndarray  # int32 [N]
+
+    @property
+    def do_codon_moves(self) -> bool:
+        """True when the batch carries real (full-width) codon-score
+        planes; False for the compact disabled sentinel."""
+        return self.cins.shape[1] > 1
 
     @property
     def n_reads(self) -> int:
@@ -241,8 +253,17 @@ def batch_reads(reads: Sequence[ReadScores], max_len: Optional[int] = None, dtyp
     mismatch = np.zeros((n, length), dtype=dtype)
     ins = np.zeros((n, length), dtype=dtype)
     dels = np.zeros((n, length + 1), dtype=dtype)
-    cins = np.full((n, length), NEG_INF, dtype=dtype)
-    cdel = np.full((n, length + 1), NEG_INF, dtype=dtype)
+    # the codon planes are read-path dead weight for standard reads
+    # (codon moves exist only in the reference alignment): when no read
+    # carries codon scores, keep a [n, 1] -inf sentinel instead of
+    # materializing two full [n, L(+1)] f32 planes of -inf
+    any_codon = any(r.do_codon_moves for r in reads)
+    if any_codon:
+        cins = np.full((n, length), NEG_INF, dtype=dtype)
+        cdel = np.full((n, length + 1), NEG_INF, dtype=dtype)
+    else:
+        cins = np.full((n, 1), NEG_INF, dtype=dtype)
+        cdel = np.full((n, 1), NEG_INF, dtype=dtype)
     bandwidth = np.zeros(n, dtype=np.int32)
 
     for k, r in enumerate(reads):

@@ -56,6 +56,7 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPar
 
 from . import stats_pallas
 from .align_jax import BandGeometry
+from .encoding import check_input_enc, dequant_block, unpack_codes
 from .fill_pallas import (
     LANES,
     NEG_INF,
@@ -150,16 +151,40 @@ def _dense_kernel(
     mm_ref,
     gi_ref,
     dl_ref,
-    sq_ref,
-    out_ref,  # VMEM [1, 1, C * ROWS, 128] per-lane join maxima
-    *,
+    sq_ref,  # packed enc: [1, CBp, 128] packed code words
+    # packed enc: qm_ref [8, 1, 128] dequant rows rides after sq
+    *refs,
     K: int,
     C: int,
+    input_enc: str = "f32",
 ):
+    refs = list(refs)
+    qm_ref = refs.pop(0) if input_enc == "packed" else None
+    out_ref = refs.pop(0)  # VMEM [1, 1, C * ROWS, 128] per-lane maxima
     tlen = tlen_ref[0, 0]
     OFF = off_ref[0, 0]
     col0 = col0_ref[0, 0]
     jb = pl.program_id(1)
+
+    if input_enc == "packed":
+        # decode the block once per grid step (ops.encoding), then take
+        # the same static windows the f32 path reads from the refs; all
+        # max-plus math below stays f32 (accumulate-wide)
+        mt_t = dequant_block(mt_ref[0], qm_ref[0, 0, :], qm_ref[4, 0, :])
+        mm_t = dequant_block(mm_ref[0], qm_ref[1, 0, :], qm_ref[5, 0, :])
+        gi_t = dequant_block(gi_ref[0], qm_ref[2, 0, :], qm_ref[6, 0, :])
+        dl_t = dequant_block(dl_ref[0], qm_ref[3, 0, :], qm_ref[7, 0, :])
+        sq_t = unpack_codes(sq_ref[0])
+
+    def tab_win(lo, hi):
+        """(sq, mt, mm, gi, dl) windows [lo, hi) of the decoded (packed)
+        or raw (f32, zero-cast) block."""
+        if input_enc == "packed":
+            return (sq_t[lo:hi, :], mt_t[lo:hi, :], mm_t[lo:hi, :],
+                    gi_t[lo:hi, :], dl_t[lo:hi, :])
+        return (sq_ref[0, lo:hi, :], mt_ref[0, lo:hi, :],
+                mm_ref[0, lo:hi, :], gi_ref[0, lo:hi, :],
+                dl_ref[0, lo:hi, :])
 
     slen = slen_ref[0, 0, :]
     roff = roff_ref[0, 0, :]
@@ -212,20 +237,12 @@ def _dense_kernel(
         # [c+1, c+1+K); insertions after j: frame j -> rows [c, c+K)
         subs = edit_scores(
             d + (j + 1 - OFF),
-            sq_ref[0, c + 1 : c + 1 + K, :],
-            mt_ref[0, c + 1 : c + 1 + K, :],
-            mm_ref[0, c + 1 : c + 1 + K, :],
-            gi_ref[0, c + 1 : c + 1 + K, :],
-            dl_ref[0, c + 1 : c + 1 + K, :],
+            *tab_win(c + 1, c + 1 + K),
             A_j, A_up, B_n,
         )
         insr = edit_scores(
             d + (j - OFF),
-            sq_ref[0, c : c + K, :],
-            mt_ref[0, c : c + K, :],
-            mm_ref[0, c : c + K, :],
-            gi_ref[0, c : c + K, :],
-            dl_ref[0, c : c + K, :],
+            *tab_win(c, c + K),
             A_dn, A_j, B_j,
         )
         out_ref[0, 0, c * ROWS : (c + 1) * ROWS, :] = jnp.concatenate(
@@ -233,7 +250,8 @@ def _dense_kernel(
         )
 
 
-@functools.partial(jax.jit, static_argnames=("K", "T1p", "C", "interpret"))
+@functools.partial(jax.jit, static_argnames=("K", "T1p", "C", "interpret",
+                                              "input_enc"))
 def dense_call(
     tlen_s,  # [1, 1] int32
     off_s,  # [1, 1] int32
@@ -246,6 +264,8 @@ def dense_call(
     C: int,
     interpret: bool = False,
     col0=None,  # [1, 1] int32 global first column (panel launches)
+    input_enc: str = "f32",
+    qmeta=None,  # [8, 1, >=Npad] f32 dequant rows (packed enc only)
 ):
     if col0 is None:
         col0 = jnp.zeros((1, 1), jnp.int32)
@@ -259,9 +279,9 @@ def dense_call(
 
     grid = (NB, n_steps)
 
-    def tab_spec():
+    def tab_spec(rows=CB):
         return pl.BlockSpec(
-            (1, CB, LANES), lambda nb, jb: (jb, 0, nb),
+            (1, rows, LANES), lambda nb, jb: (jb, 0, nb),
             memory_space=pltpu.VMEM,
         )
 
@@ -271,30 +291,49 @@ def dense_call(
             memory_space=pltpu.VMEM,
         )
 
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
+        lane_spec(),  # slen
+        lane_spec(),  # roff
+        lane_spec(),  # bw
+        pl.BlockSpec(
+            (1, C * K, LANES), lambda nb, jb: (0, jb, nb),
+            memory_space=pltpu.VMEM,
+        ),  # A block
+        pl.BlockSpec(
+            (1, (C + 1) * K, LANES), lambda nb, jb: (jb, 0, nb),
+            memory_space=pltpu.VMEM,
+        ),  # halo-blocked B
+        tab_spec(),
+        tab_spec(),
+        tab_spec(),
+        tab_spec(),
+        tab_spec(rows=sq.shape[1]),  # sq (CBp packed words / CB codes)
+    ]
+    args = [
+        tlen_s, off_s, jnp.asarray(col0, jnp.int32).reshape(1, 1),
+        meta[0][None, None], meta[1][None, None], meta[2][None, None],
+        A_flat[None],
+        Bh,
+        mt, mm, gi, dl, sq,
+    ]
+    if input_enc == "packed":
+        in_specs.append(
+            pl.BlockSpec(
+                (8, 1, LANES), lambda nb, jb: (0, 0, nb),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        # qmeta may carry extra reversed lanes (prepare_fill's combined
+        # layout) — the forward lane-block index never touches them
+        args.append(qmeta)
+
     out = pl.pallas_call(
-        functools.partial(_dense_kernel, K=K, C=C),
+        functools.partial(_dense_kernel, K=K, C=C, input_enc=input_enc),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda nb, jb: (0, 0), memory_space=pltpu.SMEM),
-            lane_spec(),  # slen
-            lane_spec(),  # roff
-            lane_spec(),  # bw
-            pl.BlockSpec(
-                (1, C * K, LANES), lambda nb, jb: (0, jb, nb),
-                memory_space=pltpu.VMEM,
-            ),  # A block
-            pl.BlockSpec(
-                (1, (C + 1) * K, LANES), lambda nb, jb: (jb, 0, nb),
-                memory_space=pltpu.VMEM,
-            ),  # halo-blocked B
-            tab_spec(),
-            tab_spec(),
-            tab_spec(),
-            tab_spec(),
-            tab_spec(),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, C * ROWS, LANES), lambda nb, jb: (nb, jb, 0, 0),
             memory_space=pltpu.VMEM,
@@ -306,13 +345,7 @@ def dense_call(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(
-        tlen_s, off_s, jnp.asarray(col0, jnp.int32).reshape(1, 1),
-        meta[0][None, None], meta[1][None, None], meta[2][None, None],
-        A_flat[None],
-        Bh,
-        mt, mm, gi, dl, sq,
-    )
+    )(*args)
     # [NB, n_steps, C*ROWS, 128] -> per-lane tables [T1p, ROWS, Npad]
     out = out.reshape(NB, n_steps, C, ROWS, LANES)
     out = out.transpose(1, 2, 3, 0, 4).reshape(T1p, ROWS, NB * LANES)
@@ -321,7 +354,7 @@ def dense_call(
 
 def dense_tables_pallas(
     tlen_s, off_s, meta, A_flat, Bh, tabs, weights, K, T1p, C,
-    interpret=False,
+    interpret=False, input_enc="f32", qmeta=None,
 ):
     """Weighted batch-total score tables from the dense kernel.
 
@@ -331,7 +364,8 @@ def dense_tables_pallas(
     mt, mm, gi, dl, sq = tabs
     per_lane = dense_call(
         tlen_s, off_s, meta, A_flat, Bh, mt, mm, gi, dl, sq,
-        K=K, T1p=T1p, C=C, interpret=interpret,
+        K=K, T1p=T1p, C=C, interpret=interpret, input_enc=input_enc,
+        qmeta=qmeta,
     )
     w = weights[None, None, :]
     tables = jnp.sum(jnp.where(w > 0, per_lane, 0.0) * w, axis=2)
@@ -403,6 +437,7 @@ def fused_tables_pallas(
     slen_min=None,
     interpret: bool = False,
     band_dtype: str = "f32",
+    input_enc: str = "f32",
 ):
     """One hill-climb iteration's device work, all-Pallas: forward +
     backward fills (one launch), backward alignment, dense all-edits
@@ -412,7 +447,10 @@ def fused_tables_pallas(
     del [T1p], plus n_errors [Npad] / edits [T1, 9] (want_stats) and the
     forward move band [Npad, K, T1p] int8 (want_moves). ``band_dtype``
     ("f32"/"bf16") selects the HBM store dtype of both band buffers;
-    scores, tables, and every reduction stay f32 either way."""
+    scores, tables, and every reduction stay f32 either way.
+    ``input_enc`` ("f32"/"packed") selects the streamed input wire
+    format (ops.encoding); the kernels decode at VMEM load and all
+    max-plus math stays f32."""
     from . import fill_pallas
 
     Npad = bufs.seq_T.shape[1]
@@ -420,12 +458,13 @@ def fused_tables_pallas(
     need_moves = want_stats or want_moves
     p = fill_pallas.prepare_fill(
         template, tlen, bufs, geom, K, T1p, C, with_backward=True,
-        off_override=off_override,
+        off_override=off_override, input_enc=input_enc,
     )
     band_flat, scores2, moves_flat = fill_pallas._fill_call(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
         K=K, T1p=T1p, NBLK=2 * NB, C=C, want_moves=need_moves,
         interpret=interpret, band_dtype=band_dtype,
+        input_enc=input_enc, qmeta=p["qmeta"],
     )
     scores = scores2[0, :Npad]
 
@@ -445,7 +484,8 @@ def fused_tables_pallas(
     ])
     sub_t, ins_t, del_t = dense_tables_pallas(
         p["tlen_s"], p["off_s"], meta3, A_flat, Bh, p["fwd_tabs"], w,
-        K, T1p, C, interpret=interpret,
+        K, T1p, C, interpret=interpret, input_enc=input_enc,
+        qmeta=p["qmeta"],
     )
     # the one epilogue lane reduction of the split path (tables reduce
     # in-kernel), routed through the shared segment-reduce helper in
@@ -469,7 +509,7 @@ def fused_tables_pallas(
                 # raw int32 move band (no int8 round trip, no XLA scan)
                 nerr, edits = stats_pallas.traceback_stats_pallas(
                     p, moves_flat, K, T1p, C, Npad, T1,
-                    interpret=interpret,
+                    interpret=interpret, input_enc=input_enc,
                 )
             else:
                 moves = _moves_band(moves_flat, K, T1p, Npad)
@@ -489,20 +529,21 @@ def fused_tables_pallas(
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "C", "want_stats", "want_moves",
-                     "interpret", "band_dtype"),
+                     "interpret", "band_dtype", "input_enc"),
 )
 def fused_step_pallas(
     template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
     K: int, T1p: int, C: int,
     want_stats: bool = False, want_moves: bool = False,
     interpret: bool = False, band_dtype: str = "f32",
+    input_enc: str = "f32",
 ):
     """Packed-single-fetch wrapper of fused_tables_pallas (layout:
     pack_layout_pallas). Returns (packed, moves-or-None)."""
     out = fused_tables_pallas(
         template, tlen, bufs, geom, weights, K, T1p, C,
         want_stats=want_stats, want_moves=want_moves, interpret=interpret,
-        band_dtype=band_dtype,
+        band_dtype=band_dtype, input_enc=input_enc,
     )
     return jnp.concatenate(pack_parts(out, want_stats)), out.get("moves")
 
@@ -546,13 +587,13 @@ def pack_layout_pallas(Npad: int, T1p: int, want_stats: bool = False,
 
 @functools.partial(
     jax.jit, static_argnames=("K", "T1p", "C", "interpret", "want_edge",
-                              "band_dtype")
+                              "band_dtype", "input_enc")
 )
 def fill_stats_pallas(
     template, tlen, bufs: FillBuffers, geom: BandGeometry,
     K: int, T1p: int, C: int, off_override=None,
     interpret: bool = False, want_edge: bool = False,
-    band_dtype: str = "f32",
+    band_dtype: str = "f32", input_enc: str = "f32",
 ):
     """Bandwidth-adaptation round on the Pallas engine: forward-only fill
     with in-kernel move recording, then the device traceback statistics —
@@ -569,12 +610,12 @@ def fill_stats_pallas(
     NB = Npad // LANES
     p = fill_pallas.prepare_fill(
         template, tlen, bufs, geom, K, T1p, C, with_backward=False,
-        off_override=off_override,
+        off_override=off_override, input_enc=input_enc,
     )
     _, scores2, moves_flat = fill_pallas._fill_call(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
         K=K, T1p=T1p, NBLK=NB, C=C, want_moves=True, interpret=interpret,
-        band_dtype=band_dtype,
+        band_dtype=band_dtype, input_enc=input_enc, qmeta=p["qmeta"],
     )
     T1 = template.shape[0] + 1
     ehits = None
@@ -583,12 +624,12 @@ def fill_stats_pallas(
         if want_edge:
             nerr, _, ehits = stats_pallas.traceback_stats_pallas(
                 p, moves_flat, K, T1p, C, Npad, T1, want_edits=False,
-                interpret=interpret, want_edge=True,
+                interpret=interpret, want_edge=True, input_enc=input_enc,
             )
         else:
             nerr, _ = stats_pallas.traceback_stats_pallas(
                 p, moves_flat, K, T1p, C, Npad, T1, want_edits=False,
-                interpret=interpret,
+                interpret=interpret, input_enc=input_enc,
             )
     else:
         moves = _moves_band(moves_flat, K, T1p, Npad)

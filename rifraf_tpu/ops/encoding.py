@@ -1,0 +1,158 @@
+"""Packed input encoding: 2-bit base codes + int8-quantized score planes.
+
+The fused step is data-movement-bound even after the PR-10 band-store
+narrowing — the next biggest resident inputs are the read-code table and
+the four per-base score planes the fill/dense/stats kernels stream every
+grid step (roofline: 5 halo'd [CB, Npad] f32 blocks per step per
+stream). This module is the single definition of the opt-in
+``input_enc="packed"`` wire format those kernels decode at VMEM load:
+
+- **Bases pack 2-bit.** Codes are in {0, 1, 2, 3}; padding/fill rows
+  carry garbage after ``& 3`` but every kernel consumes the code table
+  under its validity mask (``0 <= i <= slen`` and the per-lane band
+  limits), so decoded garbage never reaches an output. Packing happens
+  AFTER halo blocking: each ``[S, CB, Npad]`` int32 block stacks 16
+  code rows per int32 word along the sublane axis (CB padded up to a
+  multiple of 16), giving a ``[S, ceil16(CB)//16, Npad]`` word table —
+  16x fewer sublanes than the int8-widened-to-int32 plane it replaces,
+  and the in-kernel unpack is 16 shift-and-mask ops per grid step.
+
+- **Score planes quantize to int8 per read.** Every plane is affine in
+  the read's ``error_log_p`` plus a shared penalty, so one
+  (scale, offset) pair per read per plane bounds the quantization error:
+  ``scale = max(hi - lo, eps) / 254`` over the read's true-length
+  positions, ``q = clip(round((v - lo) / scale) - 127, -127, 127)``,
+  ``dequant = q * scale + offset`` with ``offset = lo + 127 * scale``.
+  The absolute dequantization error is ``<= scale / 2`` at every
+  in-range position (quantize_error_bound). Kernels dequantize the
+  whole [CB, lanes] block to f32 once per grid step and run every
+  max-plus candidate wide — accumulate-wide exactly like the PR-10
+  bf16 band store.
+
+The default ``input_enc="f32"`` path never touches this module's wire
+format: the f32 kernels read the same refs with the same zero-cast
+windows as before, bit-identical end to end.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+CODES_PER_WORD = 16  # 2-bit codes per int32 word
+QLEVELS = 254  # int8 payload levels: q in [-127, 127]
+QEPS = 1e-6  # scale floor for constant planes (error <= QEPS / 508)
+
+
+def ceil16(n: int) -> int:
+    """Round up to a multiple of CODES_PER_WORD."""
+    return ((n + CODES_PER_WORD - 1) // CODES_PER_WORD) * CODES_PER_WORD
+
+
+def packed_rows(CB: int) -> int:
+    """Sublane rows of the packed code table for a CB-row block."""
+    return ceil16(CB) // CODES_PER_WORD
+
+
+def pack_codes_blocked(blocked):
+    """Pack a halo-blocked code table ``[S, CB, lanes]`` (any int dtype;
+    values are taken mod 4, so the -9 pad sentinel packs as garbage) to
+    ``[S, ceil16(CB)//16, lanes]`` int32: word row q of block s holds
+    code rows ``{w * CBp + q : w in 0..15}`` in bit field ``2w``, the
+    layout ``unpack_codes`` inverts with a sublane concatenation."""
+    S, CB, lanes = blocked.shape
+    CB16 = ceil16(CB)
+    CBp = CB16 // CODES_PER_WORD
+    codes = blocked.astype(jnp.int32) & 3
+    codes = jnp.pad(codes, ((0, 0), (0, CB16 - CB), (0, 0)))
+    codes = codes.reshape(S, CODES_PER_WORD, CBp, lanes)
+    shifts = (2 * jnp.arange(CODES_PER_WORD, dtype=jnp.int32)).reshape(
+        1, CODES_PER_WORD, 1, 1
+    )
+    # slot 15 sets bits 30-31: the sum wraps the int32 sign bit, which
+    # is fine — unpack masks every extracted field with & 3
+    return jnp.sum(codes << shifts, axis=1).astype(jnp.int32)
+
+
+def unpack_codes(pk):
+    """Unpack one packed word block ``[CBp, lanes]`` int32 back to
+    ``[CBp * 16, lanes]`` int32 codes (the first CB rows match the
+    packed input's codes mod 4; the tail is pad). Pure shift/mask jnp —
+    safe inside a Pallas kernel body, where it runs once per grid step.
+    The arithmetic shift's sign extension at slot 15 is masked by
+    ``& 3``."""
+    return jnp.concatenate(
+        [(pk >> (2 * s)) & 3 for s in range(CODES_PER_WORD)], axis=0
+    )
+
+
+def quantize_rows(vals, mask, eps: float = QEPS):
+    """Per-row affine int8 quantization of a score plane.
+
+    ``vals`` is ``[N, L]`` float, ``mask`` the same-shape validity mask
+    (True-length positions). Returns ``(q, scale, offset)`` with ``q``
+    int8 ``[N, L]``, ``scale``/``offset`` f32 ``[N]`` such that
+    ``q * scale + offset`` reconstructs every masked value to within
+    ``scale / 2`` (quantize_error_bound). Rows with an empty mask get
+    scale = eps / QLEVELS and offset 0 (their values are never read)."""
+    vals = vals.astype(jnp.float32)
+    big = jnp.float32(jnp.finfo(jnp.float32).max)
+    any_valid = jnp.any(mask, axis=1)
+    lo = jnp.min(jnp.where(mask, vals, big), axis=1)
+    hi = jnp.max(jnp.where(mask, vals, -big), axis=1)
+    lo = jnp.where(any_valid, lo, 0.0)
+    hi = jnp.where(any_valid, hi, 0.0)
+    scale = jnp.maximum(hi - lo, eps) / QLEVELS
+    offset = lo + 127.0 * scale
+    q = jnp.round((vals - lo[:, None]) / scale[:, None]) - 127.0
+    q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+    return q, scale.astype(jnp.float32), offset.astype(jnp.float32)
+
+
+def dequantize_rows(q, scale, offset):
+    """Inverse of quantize_rows: ``q * scale + offset`` in f32."""
+    return (
+        q.astype(jnp.float32) * scale[:, None].astype(jnp.float32)
+        + offset[:, None].astype(jnp.float32)
+    )
+
+
+def quantize_error_bound(scale):
+    """Per-read absolute error bound of the int8 round trip: half a
+    quantization step. Property-tested in tests/test_input_encoding.py."""
+    return 0.5 * scale
+
+
+def dequant_block(block_ref0, scale_row, offset_row):
+    """In-kernel dequantization of one loaded int8 table block
+    ``[CB, lanes]`` against per-lane ``[lanes]`` scale/offset rows —
+    the accumulate-wide load every packed kernel shares."""
+    return (
+        block_ref0.astype(jnp.float32) * scale_row[None, :]
+        + offset_row[None, :]
+    )
+
+
+VALID_INPUT_ENCS = ("f32", "packed")
+
+
+def check_input_enc(input_enc: str) -> str:
+    """Validate and return the encoding knob (shared by params/engine/
+    sweep/serve plumbing)."""
+    if input_enc not in VALID_INPUT_ENCS:
+        raise ValueError(
+            f"input_enc must be one of {VALID_INPUT_ENCS}, got "
+            f"{input_enc!r}"
+        )
+    return input_enc
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _roundtrip_codes(blocked):
+    """Test helper: pack then unpack, cropped to the input rows."""
+    S, CB, lanes = blocked.shape
+    pk = pack_codes_blocked(blocked)
+    un = jax.vmap(unpack_codes)(pk)
+    return un[:, :CB, :]

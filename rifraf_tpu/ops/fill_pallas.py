@@ -84,6 +84,13 @@ from .align_np import (
     TRACE_MATCH,
     TRACE_NONE,
 )
+from .encoding import (
+    check_input_enc,
+    dequant_block,
+    pack_codes_blocked,
+    quantize_rows,
+    unpack_codes,
+)
 from ..utils.shapes import plan_cols
 
 # finite sentinel: avoids -inf arithmetic on the VPU (inf - inf = nan in
@@ -189,12 +196,14 @@ def _fill_kernel(
     delta_ref,
     ndv_ref,
     dend_ref,
-    # pre-blocked tables, [1, CB, 128] blocks (buffer rows [jb*C, jb*C+CB))
+    # pre-blocked tables, [1, CB, 128] blocks (buffer rows [jb*C, jb*C+CB));
+    # packed encoding: int8 planes + [1, CBp, 128] packed code words
     mt_ref,
     mm_ref,
     gi_ref,
     dl_ref,
     sq_ref,
+    # with input_enc == "packed": qm_ref [8, 1, 128] dequant rows
     # with has_carry: carry_in [K, 128] and score_in [1, 128] inputs
     # (the previous panel's final column / score accumulator)
     # outputs: out_ref [C * K, 128] band columns, score_ref [1, 128]
@@ -208,8 +217,10 @@ def _fill_kernel(
     want_moves: bool = False,
     has_carry: bool = False,
     band_neg: float = NEG_INF,
+    input_enc: str = "f32",
 ):
     refs = list(refs)
+    qm_ref = refs.pop(0) if input_enc == "packed" else None
     carry_in = score_in = None
     if has_carry:
         carry_in = refs.pop(0)
@@ -243,18 +254,40 @@ def _fill_kernel(
         else:
             acc_score[:] = jnp.full((1, LANES), NEG_INF, jnp.float32)
 
+    if input_enc == "packed":
+        # decode the whole block ONCE per grid step, then window the
+        # decoded f32/int32 arrays: 2-bit code unpack (16 shift/mask
+        # ops) + per-plane affine int8 dequant against the per-lane
+        # qmeta rows. Every max-plus candidate below accumulates in f32
+        # exactly like the f32 path — only the HBM->VMEM bytes shrank.
+        mt_t = dequant_block(mt_ref[0], qm_ref[0, 0, :], qm_ref[4, 0, :])
+        mm_t = dequant_block(mm_ref[0], qm_ref[1, 0, :], qm_ref[5, 0, :])
+        gi_t = dequant_block(gi_ref[0], qm_ref[2, 0, :], qm_ref[6, 0, :])
+        dl_t = dequant_block(dl_ref[0], qm_ref[3, 0, :], qm_ref[7, 0, :])
+        sq_t = unpack_codes(sq_ref[0])
+
     prev = carry[:]
     for c in range(C):
         j = col0 + jb * C + c
         i = d + (j - OFF)
         valid = (i >= 0) & (i <= slen[None, :]) & in_lane_band & (j <= tlen)
 
-        # static windows of the pre-blocked tables: column j = block row c
-        mw = mt_ref[0, c : c + K, :]
-        mmw = mm_ref[0, c : c + K, :]
-        giw = gi_ref[0, c : c + K, :]
-        dlw = dl_ref[0, c : c + K, :]
-        sqw = sq_ref[0, c : c + K, :]
+        if input_enc == "packed":
+            # static windows of the decoded block; pad rows decode as
+            # garbage codes mod 4 but only feed masked-out cells
+            mw = mt_t[c : c + K, :]
+            mmw = mm_t[c : c + K, :]
+            giw = gi_t[c : c + K, :]
+            dlw = dl_t[c : c + K, :]
+            sqw = sq_t[c : c + K, :]
+        else:
+            # static windows of the pre-blocked tables: column j = block
+            # row c (zero casts: the f32 default stays bit-identical)
+            mw = mt_ref[0, c : c + K, :]
+            mmw = mm_ref[0, c : c + K, :]
+            giw = gi_ref[0, c : c + K, :]
+            dlw = dl_ref[0, c : c + K, :]
+            sqw = sq_ref[0, c : c + K, :]
 
         # template base of column j (junk at j == 0); t_ref holds only
         # this launch's columns, so index locally
@@ -334,7 +367,7 @@ def _fill_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "NBLK", "C", "want_moves", "interpret",
-                     "band_dtype"),
+                     "band_dtype", "input_enc"),
 )
 def _fill_call(
     tlen_s,  # [1, 1] int32
@@ -352,6 +385,8 @@ def _fill_call(
     carry_in=None,  # [K, NBLK*128] previous panel's final column
     score_in=None,  # [1, NBLK*128] previous panel's score accumulator
     band_dtype: str = "f32",
+    input_enc: str = "f32",
+    qmeta=None,  # [8, 1, NBLK*128] f32 dequant rows (packed enc only)
 ):
     n_steps = T1p // C
     CB = mt.shape[1]
@@ -364,9 +399,9 @@ def _fill_call(
 
     grid = (NBLK, n_steps)
 
-    def tab_spec():
+    def tab_spec(rows=CB):
         return pl.BlockSpec(
-            (1, CB, LANES), lambda nb, jb: (jb, 0, nb),
+            (1, rows, LANES), lambda nb, jb: (jb, 0, nb),
             memory_space=pltpu.VMEM,
         )
 
@@ -379,7 +414,7 @@ def _fill_call(
     kernel = functools.partial(
         _fill_kernel, K=K, C=C, blocks_per_tpl=blocks_per_tpl,
         want_moves=want_moves, has_carry=has_carry,
-        band_neg=neg_inf_for(band_dt),
+        band_neg=neg_inf_for(band_dt), input_enc=input_enc,
     )
 
     out_specs = [
@@ -433,13 +468,21 @@ def _fill_call(
         tab_spec(),  # mm
         tab_spec(),  # gi
         tab_spec(),  # dl
-        tab_spec(),  # sq
+        tab_spec(rows=sq.shape[1]),  # sq (CBp packed words, CB codes f32)
     ]
     args = [
         tlen_s, off_s, jnp.asarray(col0, jnp.int32).reshape(1, 1), t_cols,
         meta[0][None], meta[1][None], meta[2][None], meta[3][None],
         mt, mm, gi, dl, sq,
     ]
+    if input_enc == "packed":
+        in_specs.append(
+            pl.BlockSpec(
+                (8, 1, LANES), lambda nb, jb: (0, 0, nb),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        args.append(qmeta)
     if has_carry:
         in_specs.append(
             pl.BlockSpec(
@@ -512,7 +555,17 @@ class FillBuffers(NamedTuple):
     """Device-resident, template-independent fill inputs: the transposed
     (+reversed, for the backward stream) score tables and lane metadata
     minus frame placement. Built once per batch selection
-    (engine.realign caches this; only the template changes per call)."""
+    (engine.realign caches this; only the template changes per call).
+
+    With ``input_enc="packed"`` (build_fill_buffers) the four score
+    planes are stored int8 (per-read affine quantization, fwd and rev
+    sharing one scale/offset because quantization happens before the
+    reversal) and ``qmeta`` carries the [8, Npad] f32 dequantization
+    table: rows 0-3 the match/mismatch/ins/dels scales, rows 4-7 the
+    offsets. ``seq_T`` stays int32 either way — the 2-bit base packing
+    happens after halo blocking (prepare_fill), and the XLA stats
+    fallback reads the unpacked codes. The default f32 encoding leaves
+    ``qmeta`` None and every dtype exactly as before."""
 
     seq_T: jnp.ndarray  # [L, Npad] int32, fwd lanes
     match_T: jnp.ndarray
@@ -525,6 +578,7 @@ class FillBuffers(NamedTuple):
     rins_T: jnp.ndarray
     rdels_T: jnp.ndarray
     lengths: jnp.ndarray  # [Npad] int32 (0 for padding lanes)
+    qmeta: Optional[jnp.ndarray] = None  # [8, Npad] f32, packed enc only
 
 
 def _pad_lanes(a, Npad: int, fill=0.0):
@@ -535,11 +589,20 @@ def _pad_lanes(a, Npad: int, fill=0.0):
     return jnp.concatenate([a, pad], axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("Npad",))
+@functools.partial(jax.jit, static_argnames=("Npad", "input_enc"))
 def build_fill_buffers(seq, match, mismatch, ins, dels, lengths,
-                       Npad: int) -> FillBuffers:
+                       Npad: int, input_enc: str = "f32") -> FillBuffers:
     """Transpose the batch tables to lanes-last and precompute the
-    reversed-read variants (template-independent; cache per batch)."""
+    reversed-read variants (template-independent; cache per batch).
+
+    ``input_enc="packed"`` additionally quantizes the four score planes
+    to int8 against a per-read scale/offset pair (ops.encoding) BEFORE
+    building the reversed variants, so the forward and reversed streams
+    of a read dequantize against the same pair; ``qmeta`` carries the
+    dequantization table. The base codes are left int32 here — the 2-bit
+    packing is applied to the halo-blocked tables in prepare_fill, where
+    the block layout the kernels unpack is known."""
+    check_input_enc(input_enc)
     f32 = jnp.float32
     sq = _pad_lanes(seq.astype(jnp.int32), Npad, -9)
     mt = _pad_lanes(match.astype(f32), Npad)
@@ -547,6 +610,21 @@ def build_fill_buffers(seq, match, mismatch, ins, dels, lengths,
     gi = _pad_lanes(ins.astype(f32), Npad)
     dl = _pad_lanes(dels.astype(f32), Npad)
     ln = _pad_lanes(lengths.astype(jnp.int32), Npad)
+    qmeta = None
+    if input_enc == "packed":
+        pos = jnp.arange(mt.shape[1], dtype=jnp.int32)
+        m_mask = pos[None, :] < ln[:, None]
+        d_mask = (
+            jnp.arange(dl.shape[1], dtype=jnp.int32)[None, :]
+            <= ln[:, None]
+        )
+        mt, s_mt, o_mt = quantize_rows(mt, m_mask)
+        mm, s_mm, o_mm = quantize_rows(mm, m_mask)
+        gi, s_gi, o_gi = quantize_rows(gi, m_mask)
+        dl, s_dl, o_dl = quantize_rows(dl, d_mask)
+        qmeta = jnp.stack(
+            [s_mt, s_mm, s_gi, s_dl, o_mt, o_mm, o_gi, o_dl]
+        )
     return FillBuffers(
         seq_T=sq.T, match_T=mt.T, mismatch_T=mm.T, ins_T=gi.T, dels_T=dl.T,
         rseq_T=_reverse_rows(sq, ln).T,
@@ -555,6 +633,7 @@ def build_fill_buffers(seq, match, mismatch, ins, dels, lengths,
         rins_T=_reverse_rows(gi, ln).T,
         rdels_T=_reverse_rows1(dl, ln).T,
         lengths=ln,
+        qmeta=qmeta,
     )
 
 
@@ -568,6 +647,7 @@ def prepare_fill(
     C: int,
     with_backward: bool = True,
     off_override=None,
+    input_enc: str = "f32",
 ):
     """Build every _fill_call input: frame scalars, per-lane metadata,
     template column tables, and the halo-blocked score tables for the
@@ -575,7 +655,12 @@ def prepare_fill(
     forward-stream blocked tables ride along for reuse by the dense
     kernel (ops.dense_pallas), which consumes the identical layout.
     ``off_override`` pins the frame offset OFF (sharded meshes pass the
-    global maximum so all shards share one frame)."""
+    global maximum so all shards share one frame). ``input_enc="packed"``
+    (bufs built with the same flag) 2-bit packs the blocked base-code
+    tables (ops.encoding.pack_codes_blocked — the score planes arrive
+    already int8 from build_fill_buffers) and adds the [8, 1, lanes]
+    ``qmeta`` dequantization rows the kernels consume."""
+    check_input_enc(input_enc)
     Npad = bufs.seq_T.shape[1]
     n_steps = T1p // C
     CB = C + K
@@ -611,12 +696,19 @@ def prepare_fill(
     row_dl = OFF
 
     def stream(sqT, mtT, mmT, giT, dlT):
+        # place() follows each table's dtype: int8 planes (packed enc)
+        # get an int8 zero fill, and the blocked base codes 2-bit pack
+        # (fill rows decode as garbage mod 4 — masked like every other
+        # out-of-range cell, see ops.encoding)
+        sq_b = _block_tables(place(sqT, row_tab, -9), n_steps, C, CB)
+        if input_enc == "packed":
+            sq_b = pack_codes_blocked(sq_b)
         return (
             _block_tables(place(mtT, row_tab, 0.0), n_steps, C, CB),
             _block_tables(place(mmT, row_tab, 0.0), n_steps, C, CB),
             _block_tables(place(giT, row_tab, 0.0), n_steps, C, CB),
             _block_tables(place(dlT, row_dl, 0.0), n_steps, C, CB),
-            _block_tables(place(sqT, row_tab, -9), n_steps, C, CB),
+            sq_b,
         )
 
     f_mt, f_mm, f_gi, f_dl, f_sq = stream(
@@ -656,6 +748,14 @@ def prepare_fill(
         t_cols = tpl[None]
         meta = jnp.stack([m[None] for m in meta_rows])
 
+    qmeta = None
+    if input_enc == "packed":
+        # fwd and rev lanes of a read share one scale/offset pair
+        # (quantization precedes the reversal in build_fill_buffers)
+        qmeta = bufs.qmeta[:, None, :]
+        if with_backward:
+            qmeta = jnp.concatenate([qmeta, qmeta], axis=2)
+
     return {
         "tlen_s": jnp.reshape(tlen, (1, 1)),
         "off_s": jnp.reshape(OFF, (1, 1)),
@@ -664,6 +764,7 @@ def prepare_fill(
         "meta": meta,
         "tabs": (mt, mm, gi, dl, sq),
         "fwd_tabs": (f_mt, f_mm, f_gi, f_dl, f_sq),
+        "qmeta": qmeta,
     }
 
 
@@ -744,7 +845,7 @@ def prepare_fill_panels(
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "C", "with_backward", "want_moves",
-                     "interpret", "band_dtype"),
+                     "interpret", "band_dtype", "input_enc"),
 )
 def fill_uniform(
     template,  # int8 [Tmax] padded template
@@ -758,6 +859,7 @@ def fill_uniform(
     want_moves: bool = False,
     interpret: bool = False,
     band_dtype: str = "f32",
+    input_enc: str = "f32",
 ):
     """Pallas banded fill in the uniform frame.
 
@@ -772,12 +874,14 @@ def fill_uniform(
     NB = Npad // LANES
     if C <= 0:
         C = plan_cols(T1p, K, kernel="fill", want_moves=want_moves).cols
-    p = prepare_fill(template, tlen, bufs, geom, K, T1p, C, with_backward)
+    p = prepare_fill(template, tlen, bufs, geom, K, T1p, C, with_backward,
+                     input_enc=input_enc)
     NBLK = 2 * NB if with_backward else NB
     band_flat, scores, moves_flat = _fill_call(
         p["tlen_s"], p["off_s"], p["t_cols"], p["meta"], *p["tabs"],
         K=K, T1p=T1p, NBLK=NBLK, C=C, want_moves=want_moves,
         interpret=interpret, band_dtype=band_dtype,
+        input_enc=input_enc, qmeta=p["qmeta"],
     )
     # [n_steps*C*K, NBLK*128] -> [T1p, K, NBLK*128] -> [lanes, K, T1p]
     band = band_flat.reshape(T1p, K, NBLK * LANES).transpose(2, 1, 0)

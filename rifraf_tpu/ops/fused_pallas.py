@@ -100,6 +100,7 @@ from .fill_pallas import (
 )
 from .align_jax import BandGeometry
 from .dense_pallas import ROWS, fused_tables_pallas, pack_parts
+from .encoding import dequant_block, pack_codes_blocked, unpack_codes
 from .stats_pallas import CARRY_ROWS, _cumop_rev, _edits_from_union, _finish_nerr
 
 
@@ -184,6 +185,7 @@ def prepare_fused(
     T1p: int,
     C: int,
     off_override=None,
+    input_enc: str = "f32",
 ):
     """Megakernel inputs: frame scalars, per-lane metadata (the fill
     AND dense rows in one stack), the forward blocked tables (same
@@ -194,7 +196,14 @@ def prepare_fused(
     in bounds) and blocked so that block jb's window for column
     c = C - 1 - (local offset) yields tileM[m] = buf[j + K - 1 - m] —
     the value the mirrored fill needs at row m, which is exactly what
-    the oracle's reversed stream reads at row d = K - 1 - m."""
+    the oracle's reversed stream reads at row d = K - 1 - m.
+
+    ``input_enc="packed"`` ships the score planes int8 (already
+    quantized in build_fill_buffers — the forward and reversed streams
+    share one qmeta because quantization happens before reversal) and
+    packs both code tables 2-bit AFTER blocking/mirroring
+    (ops.encoding.pack_codes_blocked), so the kernel's per-step decode
+    sees exactly the rows the f32 path would read."""
     Npad = bufs.seq_T.shape[1]
     n_steps = T1p // C
     CB = C + K
@@ -225,13 +234,18 @@ def prepare_fused(
     row_tab = OFF + 1
     row_dl = OFF
 
+    def pack_sq(sq_b):
+        # 2-bit pack after blocking: -9 pad packs as garbage 3, masked
+        # at every consumption site (ops.encoding module docstring)
+        return pack_codes_blocked(sq_b) if input_enc == "packed" else sq_b
+
     def fwd(sqT, mtT, mmT, giT, dlT):
         return (
             _block_tables(place(mtT, row_tab, 0.0), n_steps, C, CB),
             _block_tables(place(mmT, row_tab, 0.0), n_steps, C, CB),
             _block_tables(place(giT, row_tab, 0.0), n_steps, C, CB),
             _block_tables(place(dlT, row_dl, 0.0), n_steps, C, CB),
-            _block_tables(place(sqT, row_tab, -9), n_steps, C, CB),
+            pack_sq(_block_tables(place(sqT, row_tab, -9), n_steps, C, CB)),
         )
 
     def _mirror_blocks(buf):
@@ -251,7 +265,7 @@ def prepare_fused(
             _mirror_blocks(place(mmT, row_tab, 0.0)),
             _mirror_blocks(place(giT, row_tab, 0.0)),
             _mirror_blocks(place(dlT, row_dl, 0.0)),
-            _mirror_blocks(place(sqT, row_tab, -9)),
+            pack_sq(_mirror_blocks(place(sqT, row_tab, -9))),
         )
 
     fwd_tabs = fwd(bufs.seq_T, bufs.match_T, bufs.mismatch_T, bufs.ins_T,
@@ -277,6 +291,9 @@ def prepare_fused(
         ),
         "fwd_tabs": fwd_tabs,
         "rev_tabs": rev_tabs,
+        "qmeta": (
+            bufs.qmeta[:, None, :] if input_enc == "packed" else None
+        ),
     }
 
 
@@ -314,8 +331,12 @@ def _mega_kernel(
     n_steps: int,
     want_stats: bool,
     band_neg: float = NEG_INF,
+    input_enc: str = "f32",
 ):
     refs = list(refs)
+    # packed enc appends the [8, 1, 128] qmeta block after the tables —
+    # it arrives FIRST in *refs, before any output ref
+    qm_ref = refs.pop(0) if input_enc == "packed" else None
     dense_ref = refs.pop(0)
     score_ref = refs.pop(0)
     tiles_ref = refs.pop(0) if want_stats else None
@@ -356,6 +377,21 @@ def _mega_kernel(
             P_scr[:] = jnp.zeros((K, LANES), jnp.int32)
             acc_scr[:] = jnp.zeros((CARRY_ROWS, LANES), jnp.int32)
 
+    if input_enc == "packed":
+        # per-grid-step decode of the loaded table blocks: int8 planes
+        # dequantize against the per-lane qmeta rows (accumulate-wide —
+        # every max-plus candidate below stays f32), packed code words
+        # unpack to one code row per band row (pad garbage is masked at
+        # every consumption site)
+        def _decode(mt_r, mm_r, gi_r, dl_r, sq_r):
+            return (
+                dequant_block(mt_r[0], qm_ref[0, 0, :], qm_ref[4, 0, :]),
+                dequant_block(mm_r[0], qm_ref[1, 0, :], qm_ref[5, 0, :]),
+                dequant_block(gi_r[0], qm_ref[2, 0, :], qm_ref[6, 0, :]),
+                dequant_block(dl_r[0], qm_ref[3, 0, :], qm_ref[7, 0, :]),
+                unpack_codes(sq_r[0]),
+            )
+
     @pl.when(phase1)
     def _():
         in_band_f = (d >= delta[None, :]) & (d < (delta + nd)[None, :])
@@ -363,6 +399,14 @@ def _mega_kernel(
         # the reversed problem's band row K - 1 - m
         md = (K - 1) - d
         in_band_r = (md >= delta[None, :]) & (md < (delta + nd)[None, :])
+
+        if input_enc == "packed":
+            fmt_t, fmm_t, fgi_t, fdl_t, fsq_t = _decode(
+                fmt_ref, fmm_ref, fgi_ref, fdl_ref, fsq_ref
+            )
+            rmt_t, rmm_t, rgi_t, rdl_t, rsq_t = _decode(
+                rmt_ref, rmm_ref, rgi_ref, rdl_ref, rsq_ref
+            )
 
         prev_f = fcarry[:]
         prev_r = rcarry[:]
@@ -373,11 +417,18 @@ def _mega_kernel(
             # ---- forward fill column (fill_pallas._fill_kernel) ------
             i = d + (j - OFF)
             valid = (i >= 0) & (i <= slen[None, :]) & in_band_f & (j <= tlen)
-            mw = fmt_ref[0, c : c + K, :]
-            mmw = fmm_ref[0, c : c + K, :]
-            giw = fgi_ref[0, c : c + K, :]
-            dlw = fdl_ref[0, c : c + K, :]
-            sqw = fsq_ref[0, c : c + K, :]
+            if input_enc == "packed":
+                mw = fmt_t[c : c + K, :]
+                mmw = fmm_t[c : c + K, :]
+                giw = fgi_t[c : c + K, :]
+                dlw = fdl_t[c : c + K, :]
+                sqw = fsq_t[c : c + K, :]
+            else:
+                mw = fmt_ref[0, c : c + K, :]
+                mmw = fmm_ref[0, c : c + K, :]
+                giw = fgi_ref[0, c : c + K, :]
+                dlw = fdl_ref[0, c : c + K, :]
+                sqw = fsq_ref[0, c : c + K, :]
             tb = t_ref[0, j]
             msc = jnp.where(sqw == tb, mw, mmw)
             mcand = jnp.where(
@@ -431,11 +482,18 @@ def _mega_kernel(
                 (ir >= 0) & (ir <= slen[None, :]) & in_band_r & (j <= tlen)
             )
             o = C - 1 - c  # mirrored window offset within the block
-            rmw = rmt_ref[0, o : o + K, :]
-            rmmw = rmm_ref[0, o : o + K, :]
-            rgiw = rgi_ref[0, o : o + K, :]
-            rdlw = rdl_ref[0, o : o + K, :]
-            rsqw = rsq_ref[0, o : o + K, :]
+            if input_enc == "packed":
+                rmw = rmt_t[o : o + K, :]
+                rmmw = rmm_t[o : o + K, :]
+                rgiw = rgi_t[o : o + K, :]
+                rdlw = rdl_t[o : o + K, :]
+                rsqw = rsq_t[o : o + K, :]
+            else:
+                rmw = rmt_ref[0, o : o + K, :]
+                rmmw = rmm_ref[0, o : o + K, :]
+                rgiw = rgi_ref[0, o : o + K, :]
+                rdlw = rdl_ref[0, o : o + K, :]
+                rsqw = rsq_ref[0, o : o + K, :]
             tbr = t_ref[1, j]
             mscr = jnp.where(rsqw == tbr, rmw, rmmw)
             mcandr = jnp.where(
@@ -518,6 +576,24 @@ def _mega_kernel(
         v_off = jnp.maximum(slen - tlen, 0)
         zero_i = jnp.zeros((1, LANES), jnp.int32)
 
+        if input_enc == "packed":
+            # phase-2 re-read: the index maps park the forward table
+            # refs on block jb2 here — decode once for the dense windows
+            # and the fused stats read-base rows
+            fmt_t, fmm_t, fgi_t, fdl_t, fsq_t = _decode(
+                fmt_ref, fmm_ref, fgi_ref, fdl_ref, fsq_ref
+            )
+
+        def tab_win(lo, hi):
+            """(sq, mt, mm, gi, dl) windows [lo, hi) of the decoded
+            (packed) or raw (f32, zero-cast) forward block."""
+            if input_enc == "packed":
+                return (fsq_t[lo:hi, :], fmt_t[lo:hi, :], fmm_t[lo:hi, :],
+                        fgi_t[lo:hi, :], fdl_t[lo:hi, :])
+            return (fsq_ref[0, lo:hi, :], fmt_ref[0, lo:hi, :],
+                    fmm_ref[0, lo:hi, :], fgi_ref[0, lo:hi, :],
+                    fdl_ref[0, lo:hi, :])
+
         if want_stats:
             P = P_scr[:] > 0
             nerr = acc_scr[0:1, :]
@@ -568,21 +644,11 @@ def _mega_kernel(
                 return outs
 
             subs = edit_scores(
-                d + (j + 1 - OFF),
-                fsq_ref[0, c + 1 : c + 1 + K, :],
-                fmt_ref[0, c + 1 : c + 1 + K, :],
-                fmm_ref[0, c + 1 : c + 1 + K, :],
-                fgi_ref[0, c + 1 : c + 1 + K, :],
-                fdl_ref[0, c + 1 : c + 1 + K, :],
+                d + (j + 1 - OFF), *tab_win(c + 1, c + 1 + K),
                 A_j, A_up, B_n,
             )
             insr = edit_scores(
-                d + (j - OFF),
-                fsq_ref[0, c : c + K, :],
-                fmt_ref[0, c : c + K, :],
-                fmm_ref[0, c : c + K, :],
-                fgi_ref[0, c : c + K, :],
-                fdl_ref[0, c : c + K, :],
+                d + (j - OFF), *tab_win(c, c + K),
                 A_dn, A_j, B_j,
             )
             dense_ref[0, 0, c * ROWS : (c + 1) * ROWS, :] = jnp.concatenate(
@@ -592,7 +658,10 @@ def _mega_kernel(
             # ---- fused reverse stats column (stats_pallas) -----------
             if want_stats:
                 mv = stage_mv[c * K : (c + 1) * K, :].astype(jnp.int32)
-                sb = fsq_ref[0, c : c + K, :]
+                if input_enc == "packed":
+                    sb = fsq_t[c : c + K, :]
+                else:
+                    sb = fsq_ref[0, c : c + K, :]
                 tb = t_ref[0, j]
 
                 seed = P | ((j == tlen) & (d == dend[None, :]))
@@ -659,7 +728,7 @@ def _mega_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "C", "want_stats", "interpret",
-                     "band_dtype"),
+                     "band_dtype", "input_enc"),
 )
 def _mega_call(
     tlen_s,  # [1, 1] int32
@@ -674,6 +743,8 @@ def _mega_call(
     want_stats: bool = False,
     interpret: bool = False,
     band_dtype: str = "f32",
+    input_enc: str = "f32",
+    qmeta=None,  # [8, 1, Npad] f32 dequant rows (packed enc only)
 ):
     n_steps = T1p // C
     Npad = meta6.shape[2]
@@ -693,21 +764,22 @@ def _mega_call(
             memory_space=pltpu.VMEM,
         )
 
-    def fwd_tab_spec():
+    def fwd_tab_spec(rows=CB):
         # phase 1 streams block jb (the fill), phase 2 re-reads block
-        # jb2 (the dense windows + the stats read-base table)
+        # jb2 (the dense windows + the stats read-base table); the
+        # packed code table carries CBp word rows instead of CB
         return pl.BlockSpec(
-            (1, CB, LANES),
+            (1, rows, LANES),
             lambda nb, jb, n=n_steps: (
                 jnp.where(jb < n, jb, 2 * n - 1 - jb), 0, nb
             ),
             memory_space=pltpu.VMEM,
         )
 
-    def rev_tab_spec():
+    def rev_tab_spec(rows=CB):
         # phase-1 only; parked on the last fill block through phase 2
         return pl.BlockSpec(
-            (1, CB, LANES),
+            (1, rows, LANES),
             lambda nb, jb, n=n_steps: (
                 jnp.where(jb < n, jb, n - 1), 0, nb
             ),
@@ -719,8 +791,10 @@ def _mega_call(
          pl.BlockSpec((2, T1p), lambda nb, jb: (0, 0),
                       memory_space=pltpu.SMEM)]
         + [lane_spec() for _ in range(6)]
-        + [fwd_tab_spec() for _ in range(5)]
-        + [rev_tab_spec() for _ in range(5)]
+        + [fwd_tab_spec() for _ in range(4)]
+        + [fwd_tab_spec(rows=fwd_tabs[4].shape[1])]
+        + [rev_tab_spec() for _ in range(4)]
+        + [rev_tab_spec(rows=rev_tabs[4].shape[1])]
     )
 
     # phase-1 steps park the write-once outputs on the block phase 2
@@ -789,10 +863,25 @@ def _mega_call(
 
     mt, mm, gi, dl, sq = fwd_tabs
     rmt, rmm, rgi, rdl, rsq = rev_tabs
+    args = [
+        tlen_s, off_s, t_cols,
+        meta6[0][None], meta6[1][None], meta6[2][None],
+        meta6[3][None], meta6[4][None], meta6[5][None],
+        mt, mm, gi, dl, sq, rmt, rmm, rgi, rdl, rsq,
+    ]
+    if input_enc == "packed":
+        in_specs.append(
+            pl.BlockSpec(
+                (8, 1, LANES), lambda nb, jb: (0, 0, nb),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        args.append(qmeta)
     return pl.pallas_call(
         functools.partial(
             _mega_kernel, K=K, C=C, n_steps=n_steps,
             want_stats=want_stats, band_neg=neg_inf_for(band_dt),
+            input_enc=input_enc,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -804,12 +893,7 @@ def _mega_call(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(
-        tlen_s, off_s, t_cols,
-        meta6[0][None], meta6[1][None], meta6[2][None],
-        meta6[3][None], meta6[4][None], meta6[5][None],
-        mt, mm, gi, dl, sq, rmt, rmm, rgi, rdl, rsq,
-    )
+    )(*args)
 
 
 def fused_tables_mega(
@@ -825,6 +909,7 @@ def fused_tables_mega(
     off_override=None,
     interpret: bool = False,
     band_dtype: str = "f32",
+    input_enc: str = "f32",
 ):
     """One fused consensus step in a SINGLE Pallas launch — same dict
     contract as dense_pallas.fused_tables_pallas (minus want_moves,
@@ -849,12 +934,12 @@ def fused_tables_mega(
     NB = Npad // LANES
     n_steps = T1p // C
     prep = prepare_fused(template, tlen, bufs, geom, K, T1p, C,
-                         off_override=off_override)
+                         off_override=off_override, input_enc=input_enc)
     outs = _mega_call(
         prep["tlen_s"], prep["off_s"], prep["t_cols"], prep["meta6"],
         prep["fwd_tabs"], prep["rev_tabs"],
         K=K, T1p=T1p, C=C, want_stats=want_stats, interpret=interpret,
-        band_dtype=band_dtype,
+        band_dtype=band_dtype, input_enc=input_enc, qmeta=prep["qmeta"],
     )
     outs = list(outs)
     dense_out = outs.pop(0)
@@ -905,6 +990,7 @@ def fused_tables_auto(
     impl=None,
     vmem_budget=None,
     band_dtype: str = "f32",
+    input_enc: str = "f32",
 ):
     """Route one fused step to the megakernel or the 3-launch split
     oracle (same dict contract either way, plus out["impl"] naming the
@@ -922,6 +1008,7 @@ def fused_tables_auto(
             template, tlen, bufs, geom, weights, K, T1p, Cm,
             want_stats=want_stats, off_override=off_override,
             interpret=interpret, band_dtype=band_dtype,
+            input_enc=input_enc,
         )
     else:
         out = fused_tables_pallas(
@@ -929,6 +1016,7 @@ def fused_tables_auto(
             want_stats=want_stats, want_moves=want_moves,
             off_override=off_override, slen_min=slen_min,
             interpret=interpret, band_dtype=band_dtype,
+            input_enc=input_enc,
         )
     out["impl"] = sel
     return out
@@ -937,17 +1025,18 @@ def fused_tables_auto(
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "C", "want_stats", "interpret",
-                     "band_dtype"),
+                     "band_dtype", "input_enc"),
 )
 def _fused_step_mega(
     template, tlen, bufs: FillBuffers, geom: BandGeometry, weights,
     K: int, T1p: int, C: int,
     want_stats: bool = False, interpret: bool = False,
-    band_dtype: str = "f32",
+    band_dtype: str = "f32", input_enc: str = "f32",
 ):
     out = fused_tables_mega(
         template, tlen, bufs, geom, weights, K, T1p, C,
         want_stats=want_stats, interpret=interpret, band_dtype=band_dtype,
+        input_enc=input_enc,
     )
     return jnp.concatenate(pack_parts(out, want_stats))
 
@@ -957,6 +1046,7 @@ def fused_step_auto(
     K: int, T1p: int, C: int,
     want_stats: bool = False, want_moves: bool = False,
     interpret: bool = False, impl=None, band_dtype: str = "f32",
+    input_enc: str = "f32",
 ):
     """Packed-single-fetch dispatcher (dense_pallas.fused_step_pallas's
     contract: (packed, moves-or-None)) routing to the megakernel when
@@ -972,11 +1062,11 @@ def fused_step_auto(
         packed = _fused_step_mega(
             template, tlen, bufs, geom, weights, K, T1p, Cm,
             want_stats=want_stats, interpret=interpret,
-            band_dtype=band_dtype,
+            band_dtype=band_dtype, input_enc=input_enc,
         )
         return packed, None
     return fused_step_pallas(
         template, tlen, bufs, geom, weights, K, T1p, C,
         want_stats=want_stats, want_moves=want_moves, interpret=interpret,
-        band_dtype=band_dtype,
+        band_dtype=band_dtype, input_enc=input_enc,
     )

@@ -68,6 +68,7 @@ from jax.experimental.pallas import tpu as pltpu
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 from .align_np import TRACE_DELETE, TRACE_INSERT, TRACE_MATCH
+from .encoding import unpack_codes
 from .fill_pallas import LANES
 
 ROWS = 16  # per-column indicator tile rows (9 used; dense_pallas.ROWS)
@@ -105,13 +106,15 @@ def _stats_kernel(
     dend_ref,  # traceback end row dend = slen - tlen + OFF
     # band-layout blocks
     mv_ref,  # [C * K, 128] move codes, block jb_rev (int32 or int8)
-    sq_ref,  # [1, CB, 128] blocked read-base table (fill layout)
+    sq_ref,  # [1, CB, 128] blocked read-base table (fill layout;
+    #          packed enc: [1, CBp, 128] int32 words, ops.encoding)
     *refs,
     K: int,
     C: int,
     want_tiles: bool = True,
     has_carry: bool = False,
     want_edge: bool = False,
+    input_enc: str = "f32",
 ):
     refs = list(refs)
     # want_edge appends the per-lane TRUE band limits (delta, nd) after
@@ -155,11 +158,20 @@ def _stats_kernel(
         edge_lo = delta_ref[0, 0, :][None, :]
         edge_hi = (delta_ref[0, 0, :] + nd_ref[0, 0, :] - 1)[None, :]
 
+    if input_enc == "packed":
+        # decode the whole code block once per grid step; the sweep only
+        # compares codes under the on-path masks, so pad garbage (codes
+        # taken mod 4) never reaches an output
+        sq_t = unpack_codes(sq_ref[0])
+
     # columns DESCEND within the block (the sweep chains P toward j-1)
     for c in range(C - 1, -1, -1):
         j = col0 + jb_rev * C + c
         mv = mv_ref[c * K : (c + 1) * K, :].astype(jnp.int32)
-        sb = sq_ref[0, c : c + K, :]  # = seq[i - 1], i = d + j - OFF
+        if input_enc == "packed":
+            sb = sq_t[c : c + K, :]
+        else:
+            sb = sq_ref[0, c : c + K, :]  # = seq[i-1], i = d + j - OFF
         tb = t_ref[0, jb_rev * C + c]
 
         seed = P | ((j == tlen) & (d == dend[None, :]))
@@ -239,7 +251,7 @@ def _stats_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=("K", "T1p", "NB", "C", "want_tiles", "interpret",
-                     "want_edge"),
+                     "want_edge", "input_enc"),
 )
 def _stats_call(
     tlen_s,  # [1, 1] int32
@@ -259,6 +271,7 @@ def _stats_call(
     want_edge: bool = False,
     delta=None,  # [1, nlanes] int32 per-lane frame shift (want_edge)
     ndv=None,  # [1, nlanes] int32 per-lane TRUE band height (want_edge)
+    input_enc: str = "f32",
 ):
     """One reverse stats sweep over ``T1p`` columns and ``NB`` forward
     lane blocks (``mv_flat``/``sq``/``dend`` may carry extra reversed
@@ -366,6 +379,7 @@ def _stats_call(
         functools.partial(
             _stats_kernel, K=K, C=C, want_tiles=want_tiles,
             has_carry=has_carry, want_edge=want_edge,
+            input_enc=input_enc,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -432,14 +446,16 @@ def traceback_stats_pallas(
     want_edits: bool = True,
     interpret: bool = False,
     want_edge: bool = False,
+    input_enc: str = "f32",
 ):
     """Stats for a single-launch fill: reuses the fill's prepared
     inputs verbatim (same C, same blocked read-base table, dend from the
     same meta — so the sweep sees exactly the frame the moves were
-    recorded in). Returns (n_errors [Npad] int32, edits [T1, 9] int8 or
-    None), plus a trailing (edge_hits [Npad] int32) when ``want_edge``
-    (per-lane true band limits ride in from the same meta rows the fill
-    masked with)."""
+    recorded in; packed enc reuses the fill's packed code words, no
+    qmeta — stats only reads codes). Returns (n_errors [Npad] int32,
+    edits [T1, 9] int8 or None), plus a trailing (edge_hits [Npad]
+    int32) when ``want_edge`` (per-lane true band limits ride in from
+    the same meta rows the fill masked with)."""
     NB = Npad // LANES
     kw = {}
     if want_edge:
@@ -450,7 +466,7 @@ def traceback_stats_pallas(
         prep["tlen_s"], prep["off_s"], prep["t_cols"][:1], prep["meta"][3],
         mv_flat, prep["fwd_tabs"][4],
         K=K, T1p=T1p, NB=NB, C=C, want_tiles=want_edits,
-        interpret=interpret, **kw,
+        interpret=interpret, input_enc=input_enc, **kw,
     )
     nerr = _finish_nerr(acc, Npad)
     edits = None

@@ -170,6 +170,13 @@ class SweepStats(NamedTuple):
     band_dtype: str = "f32"
     band_growth: str = "double"
     bw_hist: Tuple = ()
+    # requested streamed-input encoding (params.input_enc). The sweep's
+    # device programs run the XLA fused step, whose inputs are always
+    # exact f32 — here the encoding is PROGRAM IDENTITY only: it keys
+    # the lru-cached program factories and the resume fingerprint so a
+    # journal written under one encoding is never replayed into a run
+    # configured for the other
+    input_enc: str = "f32"
 
 
 class BucketPlan(NamedTuple):
@@ -544,14 +551,17 @@ def plan_cells(plans: Sequence[BucketPlan]) -> int:
 
 @functools.lru_cache(maxsize=None)
 def _adapt_program(Tmax: int, K: int, want_edge: bool = False,
-                   band_dtype: str = "f32", want_guard: bool = False):
+                   band_dtype: str = "f32", want_guard: bool = False,
+                   input_enc: str = "f32"):
     """One adaptive-bandwidth round for a whole chunk: vmapped fill +
     traceback statistics, n_errors [G, N] out (plus edge_hits [G, N]
     when ``want_edge``, for the adaptive growth policy; plus the
     per-read guard flags [G, N + 1] when ``want_guard`` — the numerical
     sentinel over the freshly filled bands and scores). Module-level
     cache so repeated sweep calls reuse the jitted wrapper (a fresh
-    jax.jit per call would recompile every round of every call)."""
+    jax.jit per call would recompile every round of every call).
+    ``input_enc`` is cache-key/AOT-identity only: the XLA fused step
+    always consumes exact f32 inputs (ops.encoding is Pallas-only)."""
     import jax
 
     from ..ops import align_jax
@@ -577,7 +587,8 @@ def _adapt_program(Tmax: int, K: int, want_edge: bool = False,
     from ..serve.aot import aot_program
 
     return aot_program(
-        "sweep_adapt", (Tmax, K, want_edge, band_dtype, want_guard),
+        "sweep_adapt",
+        (Tmax, K, want_edge, band_dtype, want_guard, input_enc),
         jax.jit(jax.vmap(one)),
     )
 
@@ -585,7 +596,7 @@ def _adapt_program(Tmax: int, K: int, want_edge: bool = False,
 @functools.lru_cache(maxsize=None)
 def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
                    use_edits: bool, donate: bool,
-                   band_dtype: str = "f32"):
+                   band_dtype: str = "f32", input_enc: str = "f32"):
     """The whole INIT stage for a chunk, vmapped over the cluster axis.
     One cached program per (Tmax, K, H, min_dist, gate) signature; XLA's
     jit cache then keys on the batch avals, so every chunk of a bucket
@@ -626,7 +637,7 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
 
     return aot_program(
         "sweep_stage",
-        (Tmax, K, H, min_dist, use_edits, donate, band_dtype),
+        (Tmax, K, H, min_dist, use_edits, donate, band_dtype, input_enc),
         jax.jit(call, donate_argnums=(2,) if donate else ()),
     )
 
@@ -634,7 +645,7 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
 @functools.lru_cache(maxsize=None)
 def _seg_adapt_program(Tmax: int, K: int, S: int,
                        want_edge: bool = False, band_dtype: str = "f32",
-                       want_guard: bool = False):
+                       want_guard: bool = False, input_enc: str = "f32"):
     """Segment-packed adaptive-bandwidth round: per-lane traceback
     error counts for a chunk of packs, each lane filled against ITS
     segment's template. Per-lane values are identical to the
@@ -663,7 +674,7 @@ def _seg_adapt_program(Tmax: int, K: int, S: int,
 
     return aot_program(
         "sweep_seg_adapt",
-        (Tmax, K, S, want_edge, band_dtype, want_guard),
+        (Tmax, K, S, want_edge, band_dtype, want_guard, input_enc),
         jax.jit(jax.vmap(one)),
     )
 
@@ -671,7 +682,7 @@ def _seg_adapt_program(Tmax: int, K: int, S: int,
 @functools.lru_cache(maxsize=None)
 def _seg_stage_program(Tmax: int, K: int, H: int, min_dist: int,
                        use_edits: bool, donate: bool, S: int,
-                       band_dtype: str = "f32"):
+                       band_dtype: str = "f32", input_enc: str = "f32"):
     """The whole INIT stage for a chunk of SEGMENT-PACKED blocks: S
     clusters share each block's lane axis, hill-climbing jointly via
     the segment stage runner, vmapped over the pack axis. Same cache
@@ -716,7 +727,8 @@ def _seg_stage_program(Tmax: int, K: int, H: int, min_dist: int,
 
     return aot_program(
         "sweep_seg_stage",
-        (Tmax, K, H, min_dist, use_edits, donate, S, band_dtype),
+        (Tmax, K, H, min_dist, use_edits, donate, S, band_dtype,
+         input_enc),
         jax.jit(call, donate_argnums=(3,) if donate else ()),
     )
 
@@ -739,16 +751,19 @@ class ChunkExecutor:
                  bandwidth_pvalue: float = 0.1,
                  do_alignment_proposals: bool = False, device=None,
                  band_dtype: str = "f32", band_growth: str = "double",
-                 bw_sink=None, want_guard: bool = False):
+                 bw_sink=None, want_guard: bool = False,
+                 input_enc: str = "f32"):
         import jax
 
         from ..engine.params import resolve_dtype
+        from ..ops.encoding import check_input_enc
 
         if mesh is not None and device is not None:
             raise ValueError("pass mesh OR device, not both")
         if band_dtype not in ("f32", "bf16"):
             raise ValueError(f"unknown band_dtype: {band_dtype!r}")
         check_band_growth(band_growth)
+        check_input_enc(input_enc)
         self.mesh = mesh
         self.device = device
         self.max_iters = max_iters
@@ -763,6 +778,11 @@ class ChunkExecutor:
         # realign's read-axis shard_map wrappers) — no mesh escape hatch
         self.band_dtype = band_dtype
         self.band_growth = band_growth
+        # requested input encoding. The sweep's device programs are XLA
+        # (always exact f32 inputs), so this is program identity only —
+        # it keys the compiled-program caches and the resume
+        # fingerprint; results are bit-identical across encodings here
+        self.input_enc = input_enc
         # optional callable fed the SETTLED bandwidths of each chunk's
         # live lanes — sweep-level accounting without widening the
         # run()/collect() handle protocol the serving path relies on
@@ -921,7 +941,7 @@ class ChunkExecutor:
                 plan.band,
             )
             out = _adapt_program(Tmax, K, adaptive, self.band_dtype,
-                                 self.want_guard)(
+                                 self.want_guard, self.input_enc)(
                 sq_d, mt_d, mm_d, gi_d, dl_d, ln_d,
                 shard(bandwidths, None), w_d, t0_d, tl_d,
             )
@@ -953,7 +973,7 @@ class ChunkExecutor:
         )
         packed = _stage_program(
             Tmax, K, self.H, self.min_dist, self.use_edits, self.donate,
-            self.band_dtype,
+            self.band_dtype, self.input_enc,
         )(t0_d, tl_d, step_state)
         return packed, plan, idxs
 
@@ -1097,7 +1117,8 @@ class ChunkExecutor:
                 plan.band,
             )
             out = _seg_adapt_program(Tmax, K, S, adaptive,
-                                     self.band_dtype, self.want_guard)(
+                                     self.band_dtype, self.want_guard,
+                                     self.input_enc)(
                 sq_d, mt_d, mm_d, gi_d, dl_d, ln_d,
                 shard(bandwidths, None), w_d, sg_d, t0_d, tl_d,
             )
@@ -1134,7 +1155,7 @@ class ChunkExecutor:
         )
         packed = _seg_stage_program(
             Tmax, K, self.H, self.min_dist, self.use_edits, self.donate,
-            S, self.band_dtype,
+            S, self.band_dtype, self.input_enc,
         )(t0_d, tl_d, lv_d, step_state)
         return packed, plan, packs
 
@@ -1184,6 +1205,7 @@ def sweep_clusters_sharded(
     band_growth: str = "double",
     guard: bool = False,
     verify_fraction: float = 0.0,
+    input_enc: str = "f32",
 ):
     """One consensus per cluster, all clusters in one device program.
 
@@ -1239,6 +1261,14 @@ def sweep_clusters_sharded(
     tests/test_precision.py tolerance raises ``ResultDivergenceError``.
     Both default OFF, leaving the default path bit-identical.
 
+    ``input_enc`` records the requested streamed-input encoding
+    (params.input_enc). The sweep's device programs run the XLA fused
+    step on exact f32 inputs either way, so results are bit-identical
+    across encodings HERE — the knob keys the compiled-program caches
+    and folds into the journal fingerprint (when not the "f32"
+    default) so ``resume=True`` refuses to mix a journal written under
+    one encoding into a run configured for the other.
+
     Returns the per-cluster results IN INPUT ORDER; with
     ``return_stats`` also a SweepStats (per-bucket occupancy, padding
     waste, and timing).
@@ -1253,6 +1283,9 @@ def sweep_clusters_sharded(
     for gi, c in enumerate(clusters):
         validate_encoded_cluster(c, source=f"sweep cluster {gi}")
     check_band_growth(band_growth)
+    from ..ops.encoding import check_input_enc
+
+    check_input_enc(input_enc)
     infos = _cluster_infos(clusters, band_growth)
     n_axis = mesh.devices.size if mesh is not None else 1
     plans = plan_sweep(
@@ -1289,7 +1322,7 @@ def sweep_clusters_sharded(
                 do_alignment_proposals=do_alignment_proposals,
                 band_dtype=band_dtype, band_growth=band_growth,
                 bw_sink=bw_sink if return_stats else None,
-                want_guard=guard,
+                want_guard=guard, input_enc=input_enc,
             )
             for i in range(n_workers)
         ]
@@ -1300,7 +1333,7 @@ def sweep_clusters_sharded(
             do_alignment_proposals=do_alignment_proposals,
             band_dtype=band_dtype, band_growth=band_growth,
             bw_sink=bw_sink if return_stats else None,
-            want_guard=guard,
+            want_guard=guard, input_enc=input_enc,
         )]
 
     tasks = [
@@ -1330,6 +1363,10 @@ def sweep_clusters_sharded(
             integrity_parts += ["guard", True]
         if verify_fraction > 0.0:
             integrity_parts += ["verify_fraction", verify_fraction]
+        # like the integrity knobs, the encoding folds in only when
+        # non-default so pre-existing f32 journals stay resumable
+        if input_enc != "f32":
+            integrity_parts += ["input_enc", input_enc]
         fp = fingerprint(
             G, [tuple(i) for i in infos], _content_digest(clusters),
             max_iters, min_dist,
@@ -1537,5 +1574,6 @@ def sweep_clusters_sharded(
         band_dtype=band_dtype,
         band_growth=band_growth,
         bw_hist=_settled_bw_hist(settled_bw),
+        input_enc=input_enc,
     )
     return list(out), stats

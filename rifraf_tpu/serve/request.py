@@ -150,6 +150,14 @@ class ServeConfig:
     # is refused instead of silently mixing precisions
     band_dtype: str = "f32"
     band_growth: str = "double"
+    # streamed-input encoding ("f32" | "packed") — see
+    # engine.params.RifrafParams.input_enc. The serving micro-batches
+    # run XLA device programs (exact f32 inputs either way), but the
+    # knob keys the compiled-program caches, flows into the fallback /
+    # oracle-verify engines, and is part of the spool fingerprint: a
+    # --resume across a changed value is refused. Both encodings can
+    # coexist in one process — program caches key on the value
+    input_enc: str = "f32"
     # scores/bandwidth used by encode_cluster() and the singleton
     # fallback path; clusters submitted as ready-made ReadScores must
     # have been built with the SAME values or fallback results will not

@@ -160,6 +160,7 @@ class Worker:
             band_dtype=config.band_dtype,
             band_growth=config.band_growth,
             want_guard=config.guard,
+            input_enc=config.input_enc,
         )
         # result-integrity surface: the per-device scoreboard (shared
         # across the fleet) attributes guard trips / divergences to
@@ -409,6 +410,7 @@ class Worker:
                     band_dtype=cfg.band_dtype,
                     band_growth=cfg.band_growth,
                     scores=cfg.scores, bandwidth=cfg.bandwidth,
+                    input_enc=cfg.input_enc,
                 )
         except Exception:  # noqa: BLE001 — verifier failure != result
             self.stats.count("verify_errors")
@@ -478,6 +480,7 @@ class Worker:
                     bandwidth=cfg.bandwidth, scores=cfg.scores,
                     band_dtype=cfg.band_dtype,
                     band_growth=cfg.band_growth,
+                    input_enc=cfg.input_enc,
                 ),
             )
         self.stats.count("fallback")

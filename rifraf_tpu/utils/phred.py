@@ -9,7 +9,14 @@ from __future__ import annotations
 
 import numpy as np
 
-MIN_PHRED = 1
+# Valid phred range: FASTQ offset-33 quality strings span '!'..'~',
+# i.e. Q0..Q93, and Q0 ("error probability 1") is a legal, encodable
+# score — so 0 is the accepted LOWER bound everywhere (engine.validate
+# enforces the same [0, 93] window; the two layers intentionally share
+# these constants). Note cap_phreds separately requires its CAP to be
+# >= 1: capping every score at 0 would declare all bases certainly
+# wrong, which is a caller bug, not a data property.
+MIN_PHRED = 0
 MAX_PHRED = ord("~") - 33  # 93
 
 
@@ -31,7 +38,9 @@ def phred_to_p(q) -> np.ndarray:
 
 
 def cap_phreds(phreds, max_phred: int) -> np.ndarray:
-    """Cap phred values at a maximum (phred.jl:36-41)."""
+    """Cap phred values at a maximum (phred.jl:36-41). The cap itself
+    must be >= 1 (a 0 cap would zero every quality); individual scores
+    of 0 are valid input — see MIN_PHRED."""
     if max_phred < 1:
         raise ValueError("max phred value must be positive")
     return np.minimum(np.asarray(phreds), max_phred).astype(np.int8)

@@ -37,6 +37,32 @@ ICI_GBPS = float(os.environ.get("RIFRAF_TPU_ICI_GBPS", "200.0"))
 _F32 = 4
 
 
+def _tab_bytes_per_step(CB: int, Npad: int, input_enc: str = "f32") -> int:
+    """Per-stream per-grid-step HBM bytes of the five blocked input
+    tables (mt/mm/gi/dl score planes + read codes). "f32" streams all
+    five as 4-byte floats; "packed" (ops.encoding) streams the four
+    score planes as int8 and the codes as 2-bit-packed int32 words
+    (16 codes per lane word, ceil(CB/16) rows)."""
+    if input_enc == "packed":
+        words = -(-CB // 16)
+        return (4 * CB * 1 + words * 4) * Npad
+    return 5 * CB * Npad * _F32
+
+
+def _sq_bytes_per_step(CB: int, Npad: int, input_enc: str = "f32") -> int:
+    """Per-grid-step HBM bytes of the blocked read-code table alone
+    (the stats kernel's only input plane)."""
+    if input_enc == "packed":
+        return (-(-CB // 16)) * 4 * Npad
+    return CB * Npad * _F32
+
+
+def _qmeta_bytes(Npad: int, input_enc: str = "f32") -> int:
+    """Per-launch bytes of the packed path's [8, Npad] f32 per-read
+    scale/offset plane (zero for f32 — no metadata is shipped)."""
+    return 8 * Npad * _F32 if input_enc == "packed" else 0
+
+
 def fill_model(
     T1p: int,
     K: int,
@@ -46,6 +72,7 @@ def fill_model(
     want_moves: bool = False,
     moves_lanes: Optional[int] = None,
     band_itemsize: int = _F32,
+    input_enc: str = "f32",
 ) -> Dict[str, float]:
     """HBM bytes + VPU ops for one fill dispatch: 5 blocked tables per
     stream read once per grid step (halo'd: C+K rows per C columns),
@@ -55,10 +82,13 @@ def fill_model(
 
     ``band_itemsize`` is the HBM store width of the band tables
     (params.band_dtype: 4 for f32, 2 for bf16) — the emission tables
-    and move codes stay 4-byte regardless."""
+    and move codes stay 4-byte regardless. ``input_enc`` sets the wire
+    width of the five input tables (params.input_enc: "packed" streams
+    int8 score planes + 2-bit codes + one [8, Npad] qmeta plane)."""
     n_steps = T1p // C
     CB = C + K
-    tab = n_streams * 5 * n_steps * CB * Npad * _F32
+    tab = (n_streams * n_steps * _tab_bytes_per_step(CB, Npad, input_enc)
+           + _qmeta_bytes(Npad, input_enc))
     band = n_streams * K * T1p * Npad * band_itemsize
     moves = 0
     if want_moves:
@@ -73,21 +103,23 @@ def fill_model(
 
 
 def dense_model(T1p: int, K: int, Npad: int, C: int,
-                band_itemsize: int = _F32) -> Dict[str, float]:
+                band_itemsize: int = _F32,
+                input_enc: str = "f32") -> Dict[str, float]:
     """HBM bytes + VPU ops for the dense candidate-tables kernel plus
     the backward-alignment halo program that feeds it: the halo program
     reads the raw reversed band once and writes the halo-blocked copy;
     the kernel reads the forward half of the band, the halo-blocked
     backward band, and the 5 forward tables again, and writes the
-    [T1p, 16, Npad] per-column join maxima. All band traffic scales
-    with ``band_itemsize`` (params.band_dtype); tables and output tiles
-    stay 4-byte."""
+    [T1p, 16, Npad] per-column join maxima. Band traffic scales with
+    ``band_itemsize`` (params.band_dtype), the table re-read with
+    ``input_enc`` (params.input_enc); output tiles stay 4-byte."""
     n_steps = T1p // C
     CB = C + K
     bh = n_steps * (C + 1) * K * Npad * band_itemsize
     halo_src = K * T1p * Npad * band_itemsize  # raw Brev read (halo prog)
     rd = (K * T1p * Npad * band_itemsize + bh
-          + 5 * n_steps * CB * Npad * _F32)
+          + n_steps * _tab_bytes_per_step(CB, Npad, input_enc)
+          + _qmeta_bytes(Npad, input_enc))
     out = T1p * 16 * Npad * _F32
     # per column per base: 2 scans + joins over K rows, 9 outputs
     ops = T1p * Npad * K * (8 * (4 + 2 * math.log2(K)) + 10)
@@ -97,15 +129,18 @@ def dense_model(T1p: int, K: int, Npad: int, C: int,
 
 def stats_model(
     T1p: int, K: int, Npad: int, C: int, moves_itemsize: int = 4,
+    input_enc: str = "f32",
 ) -> Dict[str, float]:
     """HBM bytes + VPU ops for the reverse-sweep stats kernel: reads
     the move band once (int32 from the fused layout, int8 from the
-    panel store), the blocked read-base table once, and writes the
-    [T1p, 16, Npad] per-column edit tiles plus an 8-row accumulator."""
+    panel store), the blocked read-base table once (2-bit word rows
+    under ``input_enc="packed"`` — the stats sweep needs no qmeta), and
+    writes the [T1p, 16, Npad] per-column edit tiles plus an 8-row
+    accumulator."""
     n_steps = T1p // C
     CB = C + K
     moves = K * T1p * Npad * moves_itemsize
-    sq = n_steps * CB * Npad * _F32
+    sq = n_steps * _sq_bytes_per_step(CB, Npad, input_enc)
     tiles = T1p * 16 * Npad * _F32
     acc = 8 * Npad * _F32
     # per cell: decode + on-path closure (two log-K scans) + indicator
@@ -123,18 +158,22 @@ def fused_model(
     want_stats: bool = False,
     stats_itemsize: int = 4,
     band_itemsize: int = _F32,
+    input_enc: str = "f32",
 ) -> Dict[str, float]:
     """One fused consensus step: two-stream fill + backward halo +
     dense tables, plus — with ``want_stats`` — the move-band write and
     the reverse stats sweep."""
     f = fill_model(T1p, K, Npad, C, n_streams=2, want_moves=want_stats,
-                   moves_lanes=2 * Npad, band_itemsize=band_itemsize)
-    d = dense_model(T1p, K, Npad, C, band_itemsize=band_itemsize)
+                   moves_lanes=2 * Npad, band_itemsize=band_itemsize,
+                   input_enc=input_enc)
+    d = dense_model(T1p, K, Npad, C, band_itemsize=band_itemsize,
+                    input_enc=input_enc)
     total = f["bytes"] + d["bytes"]
     ops = f["ops"] + d["ops"]
     parts = {"fill": f, "dense": d}
     if want_stats:
-        s = stats_model(T1p, K, Npad, C, moves_itemsize=stats_itemsize)
+        s = stats_model(T1p, K, Npad, C, moves_itemsize=stats_itemsize,
+                        input_enc=input_enc)
         total += s["bytes"]
         ops += s["ops"]
         parts["stats"] = s
@@ -149,6 +188,7 @@ def fused_mega_model(
     want_stats: bool = False,
     spread: int = 0,
     band_itemsize: int = _F32,
+    input_enc: str = "f32",
 ) -> Dict[str, float]:
     """One SINGLE-LAUNCH fused step (ops.fused_pallas megakernel): the
     band bytes are counted ONCE per direction — each stream's band is
@@ -160,9 +200,11 @@ def fused_mega_model(
     the window (C + 2 + spread) columns instead of (C + 2))."""
     n_steps = T1p // C
     CB = C + K
-    # phase 1: both streams' tables read once; both bands written once;
-    # the move band written once (int32) when the stats chain is on
-    tab = 2 * 5 * n_steps * CB * Npad * _F32
+    # phase 1: both streams' tables read once (wire width set by
+    # input_enc, plus one qmeta plane when packed); both bands written
+    # once; the move band written once (int32) when the stats chain is on
+    tab = (2 * n_steps * _tab_bytes_per_step(CB, Npad, input_enc)
+           + _qmeta_bytes(Npad, input_enc))
     band_w = 2 * K * T1p * Npad * band_itemsize
     moves = K * T1p * Npad * _F32 if want_stats else 0.0
     # phase 2: A read back once; B read back through the rolled window
@@ -170,7 +212,7 @@ def fused_mega_model(
     # re-read; dense tiles out; moves read back + stats tiles out
     a_r = K * T1p * Npad * band_itemsize
     b_r = n_steps * (C + 2 + spread) * K * Npad * band_itemsize
-    tab2 = 5 * n_steps * CB * Npad * _F32
+    tab2 = n_steps * _tab_bytes_per_step(CB, Npad, input_enc)
     tiles = T1p * 16 * Npad * _F32
     total = tab + band_w + moves + a_r + b_r + tab2 + tiles
     if want_stats:

@@ -180,6 +180,31 @@ def test_batch_reads():
     assert np.all(np.isneginf(batch.cins))
 
 
+def test_batch_reads_codon_plane_sentinel():
+    """When NO read carries codon scores (the standard read path), the
+    batch keeps a compact [N, 1] -inf sentinel instead of dead
+    full-width codon planes; any codon-scored read restores the full
+    [N, L(+1)] planes."""
+    plain = Scores(-1.0, -2.0, -3.0)
+    r1 = make_read_scores("ACGTACG", np.full(7, -1.5), 9, plain)
+    r2 = make_read_scores("ACGT", np.full(4, -1.5), 9, plain)
+    b = batch_reads([r1, r2], dtype=np.float64)
+    assert not b.do_codon_moves
+    assert b.cins.shape == (2, 1) and b.cdel.shape == (2, 1)
+    assert np.all(np.isneginf(b.cins)) and np.all(np.isneginf(b.cdel))
+
+    codon = Scores(-1.0, -2.0, -3.0, -4.0, -5.0)
+    r3 = make_read_scores("ACGTACG", np.full(7, -1.5), 9, codon)
+    b2 = batch_reads([r1, r3], dtype=np.float64)
+    assert b2.do_codon_moves
+    L = b2.max_len
+    assert b2.cins.shape == (2, L) and b2.cdel.shape == (2, L + 1)
+    np.testing.assert_allclose(b2.cins[1, : len(r3) - 2],
+                               r3.codon_ins_scores)
+    # the codon-free read's rows stay fully disabled
+    assert np.all(np.isneginf(b2.cins[0]))
+
+
 def test_reverse_complement():
     from rifraf_tpu.utils.constants import reverse_complement
 

@@ -102,6 +102,32 @@ def test_max_phred_boundary_accepted():
     validate_phreds([0, MAX_PHRED], 2)  # inclusive range, no raise
 
 
+def test_phred_bounds_shared_and_edges():
+    """One phred window for the whole codebase: utils.phred and
+    engine.validate expose the SAME [MIN_PHRED, MAX_PHRED] = [0, 93]
+    bounds (Q0 = FASTQ '!' is legal), and validate_phreds accepts both
+    edges while rejecting one past each."""
+    from rifraf_tpu.engine import validate as ev
+    from rifraf_tpu.utils.phred import MAX_PHRED as PM, MIN_PHRED as Pm
+
+    assert ev.MIN_PHRED is Pm and ev.MAX_PHRED is PM
+    assert (Pm, PM) == (0, 93)
+    validate_phreds([Pm], 1)  # Q0 accepted
+    validate_phreds([PM], 1)  # Q93 accepted
+    with pytest.raises(PhredRangeError):
+        validate_phreds([Pm - 1], 1)
+    with pytest.raises(PhredRangeError):
+        validate_phreds([PM + 1], 1)
+    # the CAP is a config value and still must be >= 1 (capping at 0
+    # would declare every base wrong) even though scores of 0 are valid
+    from rifraf_tpu.utils.phred import cap_phreds
+
+    np.testing.assert_array_equal(cap_phreds([0, 50, 94], 93),
+                                  [0, 50, 93])
+    with pytest.raises(ValueError):
+        cap_phreds([10], 0)
+
+
 def test_rifraf_raises_typed_errors_before_dispatch():
     from rifraf_tpu.engine.driver import rifraf
 

@@ -1,0 +1,288 @@
+"""Packed streamed-input encoding (params.input_enc) harness.
+
+``input_enc="f32"`` (the default) must leave every path bit-identical:
+the f32 kernels read the score planes and read codes exactly as built,
+with no casts. ``input_enc="packed"`` packs the read bases 2-bit
+(16 codes per int32 lane word) and quantizes the four per-base score
+planes to int8 against per-read scale/offset pairs (ops.encoding),
+decoding to f32 in-register at VMEM load — accumulate-wide, like the
+bf16 band store. The lossy half is PROPERTY-BOUNDED here: the 2-bit
+pack round-trips exactly over every code and block height, and the
+int8 round trip stays within quantize_error_bound (= scale / 2) on
+every masked value. The kernel grid then gates the end product: packed
+and f32 fused steps agree on traceback statistics and stay within the
+quantization tolerance on the candidate tables, under BOTH fused-step
+routings.
+
+Every comparison test runs both encodings in-process (packed is always
+judged against the f32 oracle), so there is no per-encoding env gate;
+the CI kernels matrix's packed legs run this file — slow kernel grid
+included — under each ``RIFRAF_TPU_FUSED_IMPL`` routing.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = pytest.importorskip("jax.numpy")
+
+from rifraf_tpu.models.errormodel import ErrorModel, Scores
+from rifraf_tpu.models.sequences import make_read_scores
+from rifraf_tpu.ops import encoding
+
+SCORES = Scores.from_error_model(ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0))
+
+
+# ---- pure encoding properties (no Pallas, fast) ----------------------------
+
+
+@pytest.mark.parametrize("CB", [1, 5, 15, 16, 17, 21, 32, 33, 48])
+def test_pack_roundtrip_exact_over_block_heights(CB):
+    """pack_codes_blocked / unpack_codes round-trip every 2-bit code at
+    every row-count residue mod 16, including the -9 pad sentinel
+    (which packs as an arbitrary code and must come back as its ``& 3``
+    image — consumption sites mask pads before use)."""
+    rng = np.random.default_rng(CB)
+    blk = rng.integers(-9, 4, (3, CB, 128)).astype(np.int32)
+    # force full code coverage in row 0
+    blk[0, 0, :4] = [0, 1, 2, 3]
+    rt = np.asarray(encoding._roundtrip_codes(jnp.asarray(blk)))
+    np.testing.assert_array_equal(rt, blk & 3)
+
+
+def test_packed_rows_word_geometry():
+    assert encoding.ceil16(1) == 16
+    assert encoding.ceil16(16) == 16
+    assert encoding.ceil16(17) == 32
+    for CB in (1, 16, 17, 160, 161):
+        assert encoding.packed_rows(CB) == -(-CB // 16)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_quantize_roundtrip_within_bound(seed):
+    """Every masked value reconstructs within quantize_error_bound
+    (scale / 2), across wide, narrow, and constant per-read ranges."""
+    rng = np.random.default_rng(seed)
+    N, L = 8, 40
+    vals = -rng.uniform(0.0, 12.0, (N, L)).astype(np.float32)
+    vals[1] = -2.5  # constant row: scale floors at QEPS / QLEVELS
+    vals[2] *= 1e-3  # narrow range
+    lengths = rng.integers(1, L + 1, N)
+    mask = np.arange(L)[None, :] < lengths[:, None]
+    q, scale, offset = encoding.quantize_rows(
+        jnp.asarray(vals), jnp.asarray(mask)
+    )
+    deq = np.asarray(encoding.dequantize_rows(q, scale, offset))
+    bound = np.asarray(encoding.quantize_error_bound(scale))
+    err = np.abs(deq - vals)
+    assert (err[mask] <= bound[:, None].repeat(L, 1)[mask] + 1e-7).all()
+
+
+def test_quantize_empty_mask_rows_are_harmless():
+    vals = jnp.zeros((2, 4), jnp.float32)
+    mask = jnp.zeros((2, 4), bool)
+    q, scale, offset = encoding.quantize_rows(vals, mask)
+    assert np.isfinite(np.asarray(scale)).all()
+    assert np.isfinite(np.asarray(offset)).all()
+
+
+def test_check_input_enc():
+    assert encoding.check_input_enc("f32") == "f32"
+    assert encoding.check_input_enc("packed") == "packed"
+    with pytest.raises(ValueError, match="input_enc"):
+        encoding.check_input_enc("int8")
+
+
+def test_params_reject_unknown_input_enc():
+    from rifraf_tpu.engine.params import RifrafParams, check_params
+
+    with pytest.raises(ValueError, match="input_enc"):
+        check_params(SCORES, 60, RifrafParams(input_enc="int4"))
+
+
+# ---- kernel grid: packed vs f32 over both fused routings -------------------
+
+
+def _kernel_problem(tlen=20, n=5, seed=0):
+    from rifraf_tpu.ops import fill_pallas
+    from rifraf_tpu.ops.align_jax import BandGeometry
+
+    rng = np.random.default_rng(seed)
+    Npad, L = 128, 24
+    template = rng.integers(0, 4, tlen + 4).astype(np.int8)
+    lengths = rng.integers(tlen - 3, tlen + 3, n).astype(np.int32)
+    seqs = rng.integers(0, 4, (n, L)).astype(np.int8)
+    match = -0.05 - 0.2 * rng.random((n, L)).astype(np.float32)
+    mismatch = -1.0 - 1.5 * rng.random((n, L)).astype(np.float32)
+    ins = -1.2 - rng.random((n, L)).astype(np.float32)
+    dels = -1.1 - rng.random((n, L + 1)).astype(np.float32)
+    geom = BandGeometry.make(jnp.asarray(lengths), tlen, 3)
+    w = jnp.ones(Npad, jnp.float32)
+    ln = jnp.asarray(np.pad(lengths, (0, Npad - n)))
+
+    def bufs(enc):
+        return fill_pallas.build_fill_buffers(
+            jnp.asarray(seqs), jnp.asarray(match), jnp.asarray(mismatch),
+            jnp.asarray(ins), jnp.asarray(dels), ln, Npad, input_enc=enc,
+        )
+
+    return template, tlen, geom, w, bufs
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("impl", ["split", "mega"])
+def test_fused_tables_packed_close_to_f32(impl, monkeypatch):
+    """Same problem through fused_tables_auto at both encodings, both
+    routings (interpret mode): candidate tables within the quantization
+    tolerance, traceback statistics identical."""
+    monkeypatch.setenv("RIFRAF_TPU_PALLAS_INTERPRET", "1")
+    from rifraf_tpu.ops import fused_pallas
+
+    template, tlen, geom, w, bufs = _kernel_problem()
+    outs = {}
+    for enc in ("f32", "packed"):
+        out = fused_pallas.fused_tables_auto(
+            jnp.asarray(template), jnp.int32(tlen), bufs(enc), geom, w,
+            16, 28, 4, want_stats=True, interpret=True, impl=impl,
+            input_enc=enc,
+        )
+        outs[enc] = {k: np.asarray(v) for k, v in out.items()
+                     if k != "impl"}
+    f, p = outs["f32"], outs["packed"]
+    for k in ("total", "scores", "sub", "ins", "del"):
+        fin = np.isfinite(f[k]) & np.isfinite(p[k])
+        d = (np.max(np.abs(f[k][fin] - p[k][fin])) if fin.any()
+             else 0.0)
+        assert d < 0.05, (k, d)
+    np.testing.assert_array_equal(f["n_errors"], p["n_errors"])
+    np.testing.assert_array_equal(f["edits"], p["edits"])
+
+
+@pytest.mark.slow
+def test_batch_aligner_packed_consensus_machinery(monkeypatch):
+    """Engine-level gate (interpret): BatchAligner at input_enc="packed"
+    agrees with the f32 aligner on totals, per-read scores, and the
+    settled adaptive bandwidths — the quantization may shift scores
+    within tolerance, never the algorithmic decisions on
+    well-conditioned problems."""
+    monkeypatch.setenv("RIFRAF_TPU_PALLAS_INTERPRET", "1")
+    from rifraf_tpu.engine.realign import BatchAligner
+
+    rng = np.random.default_rng(3)
+    tlen = 24
+    template = rng.integers(0, 4, tlen).astype(np.int8)
+    reads = []
+    for _ in range(4):
+        slen = int(rng.integers(tlen - 4, tlen + 5))
+        s = rng.integers(0, 4, slen).astype(np.int8)
+        reads.append(
+            make_read_scores(s, rng.uniform(-3.0, -1.0, slen), 5, SCORES)
+        )
+    for r in reads:
+        r.bandwidth_fixed = True
+    al_f = BatchAligner(reads, dtype=np.float32)
+    al_f.realign(template, 0.1, want_stats=True)
+    al_p = BatchAligner(reads, dtype=np.float32, input_enc="packed")
+    al_p.realign(template, 0.1, want_stats=True)
+    assert al_p._total == pytest.approx(al_f._total, abs=0.1)
+    np.testing.assert_allclose(
+        np.asarray(al_p.scores), np.asarray(al_f.scores),
+        rtol=1e-3, atol=5e-2,
+    )
+
+
+# ---- fingerprints: --resume refuses to mix encodings -----------------------
+
+
+def _clusters(seed=7):
+    rng = np.random.default_rng(seed)
+    out = []
+    for tlen, n in ((20, 3), (24, 4)):
+        reads = []
+        for _ in range(n):
+            slen = tlen + int(rng.integers(-2, 3))
+            s = rng.integers(0, 4, slen).astype(np.int8)
+            reads.append(
+                make_read_scores(s, rng.uniform(-3.0, -1.0, slen), 5,
+                                 SCORES)
+            )
+        out.append(reads)
+    return out
+
+
+def test_sweep_resume_refuses_mixed_encodings(tmp_path):
+    """A journal written under the default encoding must not replay
+    into a packed-configured run (and vice versa) — the encoding is
+    part of the resume fingerprint when non-default, so pre-existing
+    f32 journals stay valid."""
+    from rifraf_tpu.io.journal import JournalError
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+
+    clusters = _clusters()
+    jp = str(tmp_path / "sweep.jsonl")
+    sweep_clusters_sharded(clusters, max_iters=5, journal_path=jp)
+    # same encoding resumes fine
+    sweep_clusters_sharded(clusters, max_iters=5, journal_path=jp,
+                           resume=True)
+    with pytest.raises(JournalError):
+        sweep_clusters_sharded(clusters, max_iters=5, journal_path=jp,
+                               resume=True, input_enc="packed")
+
+
+def test_sweep_stats_carry_input_enc():
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+
+    clusters = _clusters(seed=9)
+    res_f, st_f = sweep_clusters_sharded(clusters, max_iters=5,
+                                         return_stats=True)
+    res_p, st_p = sweep_clusters_sharded(clusters, max_iters=5,
+                                         return_stats=True,
+                                         input_enc="packed")
+    assert st_f.input_enc == "f32" and st_p.input_enc == "packed"
+    # the sweep's device programs are XLA (exact f32 inputs either
+    # way): results are bit-identical across encodings here
+    for a, b in zip(res_f, res_p):
+        np.testing.assert_array_equal(a.consensus, b.consensus)
+        assert a.score == b.score
+
+
+def test_spool_fingerprint_keys_on_input_enc():
+    from rifraf_tpu.cli.serve import _spool_fingerprint
+    from rifraf_tpu.serve.request import ServeConfig
+
+    args = types.SimpleNamespace(phred_cap=0, deadline_ms=0,
+                                 max_iters=100,
+                                 alignment_proposals=False)
+    fp_f32 = _spool_fingerprint("/nonexistent/spool.jsonl", args,
+                                ServeConfig())
+    fp_pk = _spool_fingerprint("/nonexistent/spool.jsonl", args,
+                               ServeConfig(input_enc="packed"))
+    assert fp_f32 != fp_pk
+    # the default folds NO encoding part in, so journals from before
+    # the knob existed keep matching
+    assert fp_f32 == _spool_fingerprint(
+        "/nonexistent/spool.jsonl", args, ServeConfig(input_enc="f32")
+    )
+
+
+# ---- roofline: the byte model honors the encoding --------------------------
+
+
+def test_roofline_packed_table_bytes_shrink():
+    from rifraf_tpu.utils import roofline
+
+    T1p, K, Npad, C = 1024, 64, 256, 128
+    base = roofline.fused_mega_model(T1p, K, Npad, C)
+    pk = roofline.fused_mega_model(T1p, K, Npad, C, input_enc="packed")
+    # table term: 4 int8 planes + packed code words vs 5 f32 planes
+    red = 1.0 - pk["tab_bytes"] / base["tab_bytes"]
+    assert 0.75 < red < 0.82
+    # non-table terms unchanged
+    assert pk["band_bytes"] == base["band_bytes"]
+    # both levers cut disjoint terms: combined reduction clears the
+    # headline gate
+    both = roofline.fused_mega_model(T1p, K, Npad, C, band_itemsize=2,
+                                     input_enc="packed")
+    assert 1.0 - both["bytes"] / base["bytes"] >= 0.20

@@ -169,6 +169,31 @@ def test_driver_band_dtype_consensus_matches_f32(band_dtype):
     assert consensus(band_dtype) == consensus("f32")
 
 
+def test_input_enc_f32_driver_is_bit_identical():
+    """input_enc="f32" (the default) inserts NO casts anywhere: the
+    driver's consensus AND score are bit-equal to a run whose params
+    never mention the option. (The packed-encoding accuracy harness —
+    pack/quantize property bounds plus the kernel grid — lives in
+    tests/test_input_encoding.py.)"""
+    from rifraf_tpu.engine.driver import rifraf
+    from rifraf_tpu.engine.params import RifrafParams
+
+    rng = np.random.default_rng(17)
+    template = rng.integers(0, 4, 60).astype(np.int8)
+    seqs, lps = [], []
+    for _ in range(6):
+        seq = template.copy()
+        i = rng.integers(0, len(seq))
+        seq[i] = (seq[i] + 1) % 4
+        seqs.append(seq)
+        lps.append(np.full(len(seq), -1.5))
+    base = rifraf(seqs, error_log_ps=lps, params=RifrafParams())
+    opt = rifraf(seqs, error_log_ps=lps,
+                 params=RifrafParams(input_enc="f32"))
+    np.testing.assert_array_equal(opt.consensus, base.consensus)
+    assert float(opt.state.score) == float(base.state.score)
+
+
 def test_params_reject_unknown_band_dtype():
     from rifraf_tpu.engine.params import RifrafParams, check_params
 

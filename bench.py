@@ -1345,6 +1345,27 @@ def _multichip_mode():
     print("MULTICHIP " + json.dumps(out))
 
 
+def _lint_stats() -> dict:
+    """Bench hygiene: the rifraf-lint analyzer's wall time and finding
+    counts ride the headline BENCH JSON so the invariant suite's cost
+    (and cleanliness) stays visible as the tree grows. Never fails the
+    bench — CI's lint-invariants job owns the hard gate."""
+    import os
+
+    from rifraf_tpu.analysis import run_all
+
+    try:
+        report = run_all(os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:  # pragma: no cover - diagnostic only
+        return {"error": f"{type(e).__name__}: {e}"}
+    return {
+        "wall_s": round(report["wall_s"], 3),
+        "findings": len(report["findings"]),
+        "suppressed": report["suppressed"],
+        "per_pass": report["per_pass"],
+    }
+
+
 def main():
     if "--cpu" in sys.argv:
         import os
@@ -1538,6 +1559,7 @@ def main():
             out["ref_default_1kb_256"]["vs_baseline"] = round(
                 CPU_REF_DEFAULT_SECONDS / rd, 2
             )
+    out["lint"] = _lint_stats()
     print(json.dumps(out))
     return 0
 

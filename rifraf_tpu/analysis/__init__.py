@@ -1,0 +1,103 @@
+"""rifraf-lint: invariant-enforcing static analysis for rifraf-tpu.
+
+Six passes, each driven by the declarations in ``registry.py``:
+
+==================  =================================================
+pass id             contract enforced
+==================  =================================================
+``cache-keys``      every lru_cache'd program factory's key covers the
+                    program-identity knobs (or carries an exemption)
+``fingerprints``    journal/spool fingerprint builders fold in every
+                    fingerprint knob (or carry an exemption)
+``dtype-discipline``  narrow casts (bf16/int8) in ops/ never feed
+                    max/add/reductions without a re-widen
+``layout``          pack_layout section order (guard last) and qmeta
+                    append-last/pop-first discipline
+``env-gates``       every RIFRAF_TPU_* mention is registered with a
+                    docs anchor
+``races``           serve shared state mutates only under its declared
+                    locks (static half; locktrack.py is the runtime
+                    half)
+==================  =================================================
+
+Suppression: ``# rifraf-lint: disable=<pass> -- <reason>`` on (or
+directly above) the offending line. The reason is mandatory — a bare
+suppression is itself a finding (pass id ``suppression``).
+
+CLI: ``python -m rifraf_tpu.analysis`` (exit 1 on findings). The
+package is stdlib-only and never imports JAX, so it runs anywhere.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import dtypes, envgates, keys, layout, races
+from .common import Finding, Project
+
+PASSES = (
+    ("cache-keys", keys.check_cache_keys),
+    ("fingerprints", keys.check_fingerprints),
+    ("dtype-discipline", dtypes.check),
+    ("layout", layout.check),
+    ("env-gates", envgates.check),
+    ("races", races.check),
+)
+
+PASS_IDS = tuple(p for p, _ in PASSES)
+
+
+def run_all(root, passes: Optional[Sequence[str]] = None,
+            reg=None) -> dict:
+    """Run the requested passes (default: all) against the checkout at
+    ``root``. Returns ``{"findings": [Finding], "suppressed": int,
+    "per_pass": {id: {"findings": n, "suppressed": n}},
+    "wall_s": float}`` — suppressed findings are counted, not listed,
+    and suppressions missing a reason surface as ``suppression``
+    findings."""
+    t0 = time.perf_counter()
+    project = Project(root)
+    wanted = tuple(passes) if passes else PASS_IDS
+    unknown = set(wanted) - set(PASS_IDS)
+    if unknown:
+        raise ValueError(f"unknown pass id(s): {sorted(unknown)}")
+    findings: List[Finding] = []
+    suppressed_total = 0
+    per_pass: Dict[str, dict] = {}
+    for pass_id, fn in PASSES:
+        if pass_id not in wanted:
+            continue
+        raw = fn(project, reg)
+        kept, suppressed = [], 0
+        for f in raw:
+            sf = project.file(f.path)
+            if sf is not None and sf.suppress.active(f.line, f.pass_id):
+                suppressed += 1
+            else:
+                kept.append(f)
+        findings.extend(kept)
+        suppressed_total += suppressed
+        per_pass[pass_id] = {
+            "findings": len(kept),
+            "suppressed": suppressed,
+        }
+    # reason-less suppressions across every file any pass parsed
+    for sf in project.loaded():
+        for line, pass_ids in sf.suppress.missing_reason:
+            findings.append(Finding(
+                sf.rel, line, "suppression",
+                "suppression of "
+                f"{', '.join(sorted(pass_ids))} has no reason; write "
+                "`# rifraf-lint: disable=<pass> -- <why>`",
+            ))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return {
+        "findings": findings,
+        "suppressed": suppressed_total,
+        "per_pass": per_pass,
+        "wall_s": time.perf_counter() - t0,
+    }
+
+
+__all__ = ["Finding", "Project", "PASSES", "PASS_IDS", "run_all"]

@@ -1,0 +1,68 @@
+"""CLI entry: ``python -m rifraf_tpu.analysis``.
+
+Exit status 0 = clean (suppressed findings do not fail the build; a
+suppression without a reason does), 1 = findings. ``--json`` emits a
+machine-readable report (the shape bench.py embeds as its ``lint``
+block)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import PASS_IDS, run_all
+
+
+def _default_root() -> str:
+    # rifraf_tpu/analysis/__main__.py -> repo checkout root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m rifraf_tpu.analysis",
+        description="rifraf-lint: invariant-enforcing static analysis",
+    )
+    ap.add_argument("--root", default=_default_root(),
+                    help="repo checkout to analyze (default: the "
+                         "checkout this package lives in)")
+    ap.add_argument("--passes", default="",
+                    help="comma-separated pass ids (default: all of "
+                         f"{', '.join(PASS_IDS)})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON report instead of text")
+    ap.add_argument("--list", action="store_true",
+                    help="list pass ids and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for p in PASS_IDS:
+            print(p)
+        return 0
+
+    passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+    report = run_all(args.root, passes or None)
+    findings = report["findings"]
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": report["suppressed"],
+            "per_pass": report["per_pass"],
+            "wall_s": round(report["wall_s"], 3),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        n_sup = report["suppressed"]
+        print(f"rifraf-lint: {len(findings)} finding(s), "
+              f"{n_sup} suppressed, "
+              f"{len(report['per_pass'])} pass(es) in "
+              f"{report['wall_s']:.2f}s")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Shared infrastructure for the rifraf-lint passes.
+
+Everything here is pure stdlib (``ast``/``re``/``pathlib``) — the
+analysis package must import and run on any machine, including CI
+runners and dev boxes with no JAX installed, so no module in
+``rifraf_tpu.analysis`` may import the rest of the package.
+
+The pieces:
+
+- ``Finding`` — one violation: repo-relative path, 1-based line, the
+  pass id, and a human message. ``str()`` renders the
+  ``path:line: [pass] message`` form the CLI prints.
+- ``Suppressions`` — per-file map of ``# rifraf-lint: disable=<pass>``
+  comments. A suppression must carry a reason after ``--``; one that
+  does not is ITSELF a finding (pass id ``suppression``), so silencing
+  the linter always leaves a paper trail.
+- ``SourceFile`` / ``Project`` — parsed-file cache shared by all
+  passes, with parent links on every AST node (``node._rifraf_parent``)
+  so passes can walk upward to enclosing ``if``/``with``/function
+  scopes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*rifraf-lint:\s*disable=([a-z0-9_,-]+)(?:\s*--\s*(?P<reason>.*\S))?\s*$"
+)
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    pass_id: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "pass": self.pass_id,
+            "message": self.message,
+        }
+
+
+class Suppressions:
+    """Per-file suppression map.
+
+    A trailing comment suppresses its own line; a standalone comment
+    line suppresses the NEXT line (so a suppression can sit above a
+    long statement). ``disable=a,b`` suppresses several passes at once.
+    """
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        # (line, passes) of suppressions written without a reason
+        self.missing_reason: List[Tuple[int, Set[str]]] = []
+        for i, raw in enumerate(source.splitlines(), start=1):
+            m = SUPPRESS_RE.search(raw)
+            if m is None:
+                continue
+            passes = {p.strip() for p in m.group(1).split(",") if p.strip()}
+            target = i if raw[: m.start()].strip() else i + 1
+            self.by_line.setdefault(target, set()).update(passes)
+            if not m.group("reason"):
+                self.missing_reason.append((i, passes))
+
+    def active(self, line: int, pass_id: str) -> bool:
+        return pass_id in self.by_line.get(line, ())
+
+
+def attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._rifraf_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_rifraf_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+class SourceFile:
+    def __init__(self, path: Path, root: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.source = path.read_text()
+        self.tree = ast.parse(self.source, filename=str(path))
+        attach_parents(self.tree)
+        self.suppress = Suppressions(self.source)
+
+    def functions(self) -> Iterator[ast.FunctionDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def find_function(self, name: str) -> Optional[ast.FunctionDef]:
+        for fn in self.functions():
+            if fn.name == name:
+                return fn
+        return None
+
+    def find_class(self, name: str) -> Optional[ast.ClassDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef) and node.name == name:
+                return node
+        return None
+
+
+class Project:
+    """Parsed-file cache rooted at the repo checkout."""
+
+    def __init__(self, root):
+        self.root = Path(root).resolve()
+        self._cache: Dict[str, Optional[SourceFile]] = {}
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        if rel not in self._cache:
+            path = self.root / rel
+            if path.is_file():
+                self._cache[rel] = SourceFile(path, self.root)
+            else:
+                self._cache[rel] = None
+        return self._cache[rel]
+
+    def iter_py(self, rel: str, skip: Tuple[str, ...] = ()) -> List[SourceFile]:
+        """Every parsed .py under ``rel`` (a file or directory),
+        skipping any repo-relative prefix in ``skip``."""
+        path = self.root / rel
+        out: List[SourceFile] = []
+        if path.is_file():
+            sf = self.file(rel)
+            return [sf] if sf is not None else []
+        if not path.is_dir():
+            return []
+        for p in sorted(path.rglob("*.py")):
+            r = p.relative_to(self.root).as_posix()
+            if any(r == s or r.startswith(s + "/") for s in skip):
+                continue
+            sf = self.file(r)
+            if sf is not None:
+                out.append(sf)
+        return out
+
+    def loaded(self) -> List[SourceFile]:
+        return [sf for sf in self._cache.values() if sf is not None]
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jnp.bfloat16' for Attribute/Name chains, '' otherwise."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    """Trailing identifier of a call target: jnp.max -> 'max'."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def in_with_lock(node: ast.AST, locks: Tuple[str, ...]) -> bool:
+    """Whether ``node`` sits inside a ``with self.<lock>:`` block for
+    any lock name in ``locks``."""
+    for anc in ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                expr = item.context_expr
+                # `with self._lock:` or `with self._cv:` ...
+                if (
+                    isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                    and expr.attr in locks
+                ):
+                    return True
+                # ... or `with self._lock.acquire_timeout(...)`-style
+                # calls on the lock object
+                if (
+                    isinstance(expr, ast.Call)
+                    and isinstance(expr.func, ast.Attribute)
+                    and isinstance(expr.func.value, ast.Attribute)
+                    and isinstance(expr.func.value.value, ast.Name)
+                    and expr.func.value.value.id == "self"
+                    and expr.func.value.attr in locks
+                ):
+                    return True
+    return False
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.FunctionDef]:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None

@@ -1,0 +1,168 @@
+"""Pass 3: dtype discipline — store narrow, accumulate wide.
+
+The bf16 band store and int8 score planes win their HBM-bandwidth
+savings ONLY because every arithmetic accumulation (the max-plus
+recurrence, reductions, dot products) runs in float32: a narrow value
+must be re-widened at load before it feeds max/add. Until now this
+contract lived in bit-identity tests that can say "something drifted"
+but not WHERE; this pass enforces it structurally.
+
+Per function (the contract is local — narrow values are created at
+store boundaries and re-widened at load boundaries inside the same
+function), the pass tracks:
+
+- narrowing casts: ``x.astype(jnp.bfloat16)``, ``.astype("int8")``,
+  ``lax.convert_element_type(x, jnp.bfloat16)``, and casts to a dtype
+  variable bound from a registry NARROW_RESOLVER
+  (``band_store_dtype(...)`` — dynamically f32 OR bf16, so it must be
+  treated as potentially narrow);
+- names bound to narrow values (cleared on any other reassignment);
+- widening: ``.astype(jnp.float32)`` / other WIDE_DTYPES casts clear
+  the taint.
+
+A narrow expression or tainted name appearing as an operand of an
+accumulate call (``jnp.max``/``maximum``/``sum``/``dot``/
+``logsumexp10``/``summax``/...) or of a ``+`` binop is a finding.
+Storing narrow values (assignments, ``ref[...] = x``, concatenate,
+where/select) is fine — that is the point of the narrow store.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import registry as default_registry
+from .common import Finding, Project, call_name, dotted_name
+
+
+def _dtype_token(node: ast.AST) -> str:
+    """'bfloat16' from jnp.bfloat16 / np.int8 / 'int8' literals."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+class _FnChecker(ast.NodeVisitor):
+    def __init__(self, sf, reg, findings: List[Finding]):
+        self.sf = sf
+        self.reg = reg
+        self.findings = findings
+        self.narrow_names: Set[str] = set()
+        # names bound to a dtype object that may be narrow (e.g.
+        # band_dt = band_store_dtype(band_dtype))
+        self.narrow_dtype_vars: Set[str] = set()
+
+    # ---- classification ----
+
+    def _is_narrow_dtype_expr(self, node: ast.AST) -> bool:
+        tok = _dtype_token(node)
+        if tok in self.reg.NARROW_DTYPES:
+            return True
+        if isinstance(node, ast.Name) and node.id in self.narrow_dtype_vars:
+            return True
+        if isinstance(node, ast.Call) and \
+                call_name(node) in self.reg.NARROW_RESOLVERS:
+            return True
+        return False
+
+    def _is_wide_dtype_expr(self, node: ast.AST) -> bool:
+        return _dtype_token(node) in self.reg.WIDE_DTYPES
+
+    def _is_narrow_value(self, node: ast.AST) -> bool:
+        """Whether an expression yields a narrow-dtype value."""
+        if isinstance(node, ast.Name):
+            return node.id in self.narrow_names
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name == "astype" and node.args:
+                if self._is_narrow_dtype_expr(node.args[0]):
+                    return True
+                if self._is_wide_dtype_expr(node.args[0]):
+                    return False
+                # dynamic dtype (e.g. .astype(out_ref.dtype)): unknown,
+                # treat as clean — the storing side owns the contract
+                return False
+            if name == "convert_element_type" and len(node.args) >= 2:
+                return self._is_narrow_dtype_expr(node.args[1])
+            # a narrow value piped through shape-only ops stays narrow
+            if name in ("reshape", "transpose", "squeeze", "ravel") and \
+                    isinstance(node.func, ast.Attribute) and \
+                    self._is_narrow_value(node.func.value):
+                return True
+            return False
+        if isinstance(node, (ast.Subscript,)):
+            return self._is_narrow_value(node.value)
+        return False
+
+    # ---- taint bookkeeping ----
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        is_narrow = self._is_narrow_value(node.value)
+        is_narrow_dtype = isinstance(node.value, ast.Call) and \
+            call_name(node.value) in self.reg.NARROW_RESOLVERS
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                self.narrow_names.discard(tgt.id)
+                self.narrow_dtype_vars.discard(tgt.id)
+                if is_narrow:
+                    self.narrow_names.add(tgt.id)
+                if is_narrow_dtype:
+                    self.narrow_dtype_vars.add(tgt.id)
+
+    # ---- accumulation checks ----
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            self.sf.rel, getattr(node, "lineno", 1), "dtype-discipline",
+            f"narrow-dtype value flows into {what} without a re-widen; "
+            "store narrow, accumulate wide (.astype(jnp.float32) "
+            "before max/add)",
+        ))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name in self.reg.ACCUMULATE_CALLS:
+            operands = list(node.args)
+            if isinstance(node.func, ast.Attribute):
+                # x.max() / x.sum(): the receiver is the operand
+                operands.append(node.func.value)
+            for arg in operands:
+                if self._is_narrow_value(arg):
+                    self._flag(node, f"accumulate call '{name}'")
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, (ast.Add, ast.Sub, ast.Mult, ast.MatMult)):
+            for side in (node.left, node.right):
+                if self._is_narrow_value(side):
+                    self._flag(node, "arithmetic binop")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.op, (ast.Add, ast.Sub, ast.Mult)):
+            if self._is_narrow_value(node.value):
+                self._flag(node, "augmented accumulation")
+        self.generic_visit(node)
+
+    # nested defs are visited standalone by check() — do not descend,
+    # or their statements would be checked twice with leaked taint
+    def visit_FunctionDef(self, node) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def check(project: Project, reg=None) -> List[Finding]:
+    reg = reg or default_registry
+    findings: List[Finding] = []
+    for scan in reg.DTYPE_SCAN:
+        for sf in project.iter_py(scan):
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    checker = _FnChecker(sf, reg, findings)
+                    for stmt in node.body:
+                        checker.visit(stmt)
+    return findings

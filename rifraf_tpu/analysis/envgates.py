@@ -1,0 +1,63 @@
+"""Pass 5: env-gate registry.
+
+Every ``RIFRAF_TPU_*`` name the code mentions must be declared in
+``registry.ENV_GATES`` with a docs anchor, and the anchor file must
+actually mention the name. The scan matches whole string literals
+(``os.environ.get("RIFRAF_TPU_X")``, ``ENV_VAR = "RIFRAF_TPU_X"``,
+monkeypatch.setenv targets), so a gate cannot be introduced through a
+module-level name constant without registering it; names embedded in
+docstrings or longer strings are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from . import registry as default_registry
+from .common import Finding, Project
+
+ENV_NAME_RE = re.compile(r"RIFRAF_TPU_[A-Z0-9_]+\Z")
+
+
+def check(project: Project, reg=None) -> List[Finding]:
+    reg = reg or default_registry
+    pass_id = "env-gates"
+    out: List[Finding] = []
+    seen = set()
+    for scan in reg.ENV_SCAN:
+        for sf in project.iter_py(scan, skip=tuple(reg.ENV_SKIP)):
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and ENV_NAME_RE.fullmatch(node.value)):
+                    continue
+                name = node.value
+                seen.add(name)
+                if name not in reg.ENV_GATES:
+                    out.append(Finding(
+                        sf.rel, node.lineno, pass_id,
+                        f"env gate '{name}' is not registered in "
+                        "registry.ENV_GATES; declare it with a docs "
+                        "anchor",
+                    ))
+    for name, anchor in reg.ENV_GATES.items():
+        doc = project.root / anchor
+        if not doc.is_file():
+            out.append(Finding(
+                anchor, 1, pass_id,
+                f"docs anchor for '{name}' does not exist",
+            ))
+        elif name not in doc.read_text():
+            out.append(Finding(
+                anchor, 1, pass_id,
+                f"docs anchor '{anchor}' never mentions '{name}'",
+            ))
+        elif name not in seen:
+            out.append(Finding(
+                anchor, 1, pass_id,
+                f"registered env gate '{name}' is no longer read "
+                "anywhere; drop it from registry.ENV_GATES",
+            ))
+    return out

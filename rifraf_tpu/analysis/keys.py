@@ -1,0 +1,171 @@
+"""Passes 1 and 2: cache-key completeness and fingerprint coverage.
+
+Pass ``cache-keys``: every ``lru_cache``'d function in the factory scan
+set is a compiled-program factory whose parameter list IS its routing
+key. Each must be registered, and each registered factory must carry
+every program-identity knob in its parameters — or carry a written
+exemption. The registry itself is validated: a factory whose
+required+exempt sets do not cover the full knob list is a finding, so
+declaring a NEW knob in the registry forces an explicit decision at
+every factory.
+
+Pass ``fingerprints``: the resumable-journal fingerprint builders must
+mention every fingerprint knob (as a parameter, attribute, or
+string-literal part label) somewhere in their body. This is a
+reachability check, not a dataflow proof — the regression tests pin the
+actual digests — but it catches the real historical failure mode: a
+knob added to the sweep config and never threaded into the digest.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from . import registry as default_registry
+from .common import Finding, Project, call_name
+
+
+def _is_lru_cached(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = target.attr if isinstance(target, ast.Attribute) else (
+            target.id if isinstance(target, ast.Name) else ""
+        )
+        if name == "lru_cache":
+            return True
+    return False
+
+
+def _param_names(fn: ast.FunctionDef) -> set:
+    args = fn.args
+    names = {a.arg for a in args.args + args.kwonlyargs + args.posonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def check_cache_keys(project: Project, reg=None) -> List[Finding]:
+    reg = reg or default_registry
+    pass_id = "cache-keys"
+    out: List[Finding] = []
+    seen = set()
+    for scan in reg.FACTORY_SCAN:
+        for sf in project.iter_py(scan):
+            for fn in sf.functions():
+                if not _is_lru_cached(fn):
+                    continue
+                key = (sf.rel, fn.name)
+                seen.add(key)
+                entry = reg.PROGRAM_FACTORIES.get(key)
+                if entry is None:
+                    out.append(Finding(
+                        sf.rel, fn.lineno, pass_id,
+                        f"lru_cache'd factory '{fn.name}' is not in "
+                        "registry.PROGRAM_FACTORIES; declare its "
+                        "program-identity knobs (or exemptions) there",
+                    ))
+                    continue
+                covered = set(entry["required"]) | set(entry["exempt"])
+                missing_decl = set(reg.PROGRAM_IDENTITY_KNOBS) - covered
+                if missing_decl:
+                    out.append(Finding(
+                        sf.rel, fn.lineno, pass_id,
+                        f"registry entry for '{fn.name}' does not "
+                        f"account for knob(s) "
+                        f"{sorted(missing_decl)}; add each to "
+                        "'required' or 'exempt' (with a reason)",
+                    ))
+                for knob, reason in entry["exempt"].items():
+                    if not (reason or "").strip():
+                        out.append(Finding(
+                            sf.rel, fn.lineno, pass_id,
+                            f"exemption of knob '{knob}' on "
+                            f"'{fn.name}' has no reason",
+                        ))
+                params = _param_names(fn)
+                for knob in entry["required"]:
+                    aliases = reg.KNOB_ALIASES.get(knob, (knob,))
+                    if not params.intersection(aliases):
+                        out.append(Finding(
+                            sf.rel, fn.lineno, pass_id,
+                            f"factory '{fn.name}' cache key is missing "
+                            f"program-identity knob '{knob}' (accepted "
+                            f"parameter names: {', '.join(aliases)})",
+                        ))
+    # stale registry rows: a registered factory that no longer exists
+    # (renamed/moved) would otherwise silently stop being checked
+    for (rel, name) in reg.PROGRAM_FACTORIES:
+        if (rel, name) in seen:
+            continue
+        sf = project.file(rel)
+        out.append(Finding(
+            rel, 1, pass_id,
+            f"registered factory '{name}' not found"
+            + ("" if sf is not None else f" (file '{rel}' missing)"),
+        ))
+    return out
+
+
+def _body_tokens(fn: ast.FunctionDef) -> set:
+    """Every identifier-ish token in a function: parameter names, Name
+    loads, attribute names, call targets, and string literals (the
+    part labels fold_nondefault emits)."""
+    tokens = set(_param_names(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            tokens.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            tokens.add(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            tokens.add(node.value)
+        elif isinstance(node, ast.Call):
+            tokens.add(call_name(node))
+    return tokens
+
+
+def check_fingerprints(project: Project, reg=None) -> List[Finding]:
+    reg = reg or default_registry
+    pass_id = "fingerprints"
+    out: List[Finding] = []
+    for (rel, name), entry in reg.FINGERPRINT_BUILDERS.items():
+        sf = project.file(rel)
+        if sf is None:
+            out.append(Finding(rel, 1, pass_id,
+                               f"fingerprint builder file '{rel}' missing"))
+            continue
+        fn = sf.find_function(name)
+        if fn is None:
+            out.append(Finding(
+                sf.rel, 1, pass_id,
+                f"fingerprint builder '{name}' not found in '{rel}'",
+            ))
+            continue
+        covered = set(entry["required"]) | set(entry["exempt"])
+        missing_decl = set(reg.FINGERPRINT_KNOBS) - covered
+        if missing_decl:
+            out.append(Finding(
+                sf.rel, fn.lineno, pass_id,
+                f"registry entry for '{name}' does not account for "
+                f"fingerprint knob(s) {sorted(missing_decl)}",
+            ))
+        for knob, reason in entry["exempt"].items():
+            if not (reason or "").strip():
+                out.append(Finding(
+                    sf.rel, fn.lineno, pass_id,
+                    f"exemption of fingerprint knob '{knob}' on "
+                    f"'{name}' has no reason",
+                ))
+        tokens = _body_tokens(fn)
+        for knob in entry["required"]:
+            aliases = reg.FINGERPRINT_ALIASES.get(knob, (knob,))
+            if not tokens.intersection(aliases):
+                out.append(Finding(
+                    sf.rel, fn.lineno, pass_id,
+                    f"fingerprint builder '{name}' never folds in "
+                    f"knob '{knob}' (looked for: "
+                    f"{', '.join(aliases)})",
+                ))
+    return out

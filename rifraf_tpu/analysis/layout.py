@@ -1,0 +1,216 @@
+"""Pass 4: packed-array layout contracts.
+
+Two halves:
+
+(a) ``pack_layout`` ordering — the slice map in ``ops/fused.py`` is the
+single source of truth for the fused step's packed host transfer. Its
+``take(name, size)`` calls must appear in exactly the registry's
+canonical order, each under exactly the registry's gating flags, and
+the guard section must come LAST: every consumer (and every journal
+written by an integrity-off run) depends on pre-guard offsets being
+byte-identical whether or not the guard section exists.
+
+(b) qmeta discipline — the packed input encoding ships an ``[8, 1,
+128]`` dequant-row block as an EXTRA kernel input. The contract keeping
+f32-encoding callers byte-identical: every ``args.append(qmeta)`` sits
+inside an ``if input_enc == "packed"`` gate with its paired
+``in_specs.append(...)`` in the same gated block, and inside the
+kernels the qmeta ref is popped FIRST from ``*refs`` (before any other
+conditional or output ref), so the positional layout of every other
+ref is independent of the encoding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from . import registry as default_registry
+from .common import Finding, Project, ancestors, call_name, enclosing_function
+
+
+# ---- (a) pack_layout ordering ----
+
+def _collect_takes(fn: ast.FunctionDef):
+    """(name, gating-flag tuple, lineno) per take() call, in source
+    order. Gating flags are the Name tests of enclosing ifs inside the
+    layout function."""
+    out = []
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call) and call_name(node) == "take"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        flags = []
+        for anc in ancestors(node):
+            if anc is fn:
+                break
+            if isinstance(anc, ast.If) and isinstance(anc.test, ast.Name):
+                flags.append(anc.test.id)
+        out.append((node.args[0].value, tuple(reversed(flags)),
+                    node.lineno))
+    out.sort(key=lambda t: t[2])
+    return out
+
+
+def _check_pack_layout(project: Project, reg) -> List[Finding]:
+    pass_id = "layout"
+    out: List[Finding] = []
+    sf = project.file(reg.PACK_LAYOUT_FILE)
+    if sf is None:
+        return [Finding(reg.PACK_LAYOUT_FILE, 1, pass_id,
+                        "pack_layout file missing")]
+    fn = sf.find_function(reg.PACK_LAYOUT_FUNC)
+    if fn is None:
+        return [Finding(sf.rel, 1, pass_id,
+                        f"'{reg.PACK_LAYOUT_FUNC}' not found")]
+    takes = _collect_takes(fn)
+    canon = list(reg.PACK_LAYOUT)
+    for i, (name, flags, line) in enumerate(takes):
+        if i >= len(canon):
+            out.append(Finding(
+                sf.rel, line, pass_id,
+                f"unexpected extra pack_layout section '{name}'; "
+                "register it in registry.PACK_LAYOUT (new sections "
+                "must go BEFORE the guard tail only if every consumer "
+                "is updated)",
+            ))
+            continue
+        want_name, want_flags = canon[i]
+        if name != want_name:
+            out.append(Finding(
+                sf.rel, line, pass_id,
+                f"pack_layout section #{i} is '{name}', registry "
+                f"expects '{want_name}' — reordering breaks every "
+                "packed-offset consumer",
+            ))
+        elif tuple(flags) != tuple(want_flags):
+            out.append(Finding(
+                sf.rel, line, pass_id,
+                f"pack_layout section '{name}' gated by "
+                f"{list(flags)}, registry expects {list(want_flags)}",
+            ))
+    if len(takes) < len(canon):
+        missing = [n for n, _ in canon[len(takes):]]
+        out.append(Finding(
+            sf.rel, fn.lineno, pass_id,
+            f"pack_layout is missing registered section(s) {missing}",
+        ))
+    if takes and takes[-1][0] != reg.PACK_TAIL and \
+            any(n == reg.PACK_TAIL for n, _, _ in takes):
+        out.append(Finding(
+            sf.rel, takes[-1][2], pass_id,
+            f"'{reg.PACK_TAIL}' must be the LAST pack_layout section "
+            "so integrity-off layouts stay byte-identical",
+        ))
+    return out
+
+
+# ---- (b) qmeta append/pop discipline ----
+
+def _gated_packed(node: ast.AST, reg) -> Optional[ast.If]:
+    """The enclosing `if input_enc == "packed"` statement, if any."""
+    for anc in ancestors(node):
+        if isinstance(anc, ast.If):
+            t = anc.test
+            if (isinstance(t, ast.Compare)
+                    and isinstance(t.left, ast.Name)
+                    and t.left.id == reg.QMETA_GATE_NAME
+                    and len(t.comparators) == 1
+                    and isinstance(t.comparators[0], ast.Constant)
+                    and t.comparators[0].value == reg.QMETA_GATE_VALUE):
+                return anc
+    return None
+
+
+def _is_refs_pop0(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "pop"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "refs"
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == 0)
+
+
+def _check_qmeta(project: Project, reg) -> List[Finding]:
+    pass_id = "layout"
+    out: List[Finding] = []
+    for rel in reg.QMETA_FILES:
+        sf = project.file(rel)
+        if sf is None:
+            out.append(Finding(rel, 1, pass_id, "qmeta file missing"))
+            continue
+        # appends: args.append(qmeta) gated + spec-paired
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "args"
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == "qmeta"):
+                continue
+            gate = _gated_packed(node, reg)
+            if gate is None:
+                out.append(Finding(
+                    sf.rel, node.lineno, pass_id,
+                    "args.append(qmeta) outside an "
+                    f"`if {reg.QMETA_GATE_NAME} == "
+                    f"\"{reg.QMETA_GATE_VALUE}\"` gate — the f32 "
+                    "encoding would ship a phantom kernel input",
+                ))
+                continue
+            spec_ok = False
+            for sub in ast.walk(gate):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "append"
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "in_specs"
+                        and sub.lineno < node.lineno):
+                    spec_ok = True
+            if not spec_ok:
+                out.append(Finding(
+                    sf.rel, node.lineno, pass_id,
+                    "args.append(qmeta) without a paired "
+                    "in_specs.append(...) earlier in the same gated "
+                    "block — args and in_specs would desync",
+                ))
+        # kernels: the packed-gated refs.pop(0) must be the FIRST pop
+        for fn in sf.functions():
+            pops = []
+            for node in ast.walk(fn):
+                if _is_refs_pop0(node):
+                    pops.append(node)
+            pops.sort(key=lambda n: (n.lineno, n.col_offset))
+            for i, pop in enumerate(pops):
+                p = getattr(pop, "_rifraf_parent", None)
+                is_qmeta_pop = (
+                    isinstance(p, ast.IfExp)
+                    and isinstance(p.test, ast.Compare)
+                    and isinstance(p.test.left, ast.Name)
+                    and p.test.left.id == reg.QMETA_GATE_NAME
+                    and len(p.test.comparators) == 1
+                    and isinstance(p.test.comparators[0], ast.Constant)
+                    and (p.test.comparators[0].value
+                         == reg.QMETA_GATE_VALUE)
+                    and p.body is pop
+                )
+                if is_qmeta_pop and i != 0:
+                    out.append(Finding(
+                        sf.rel, pop.lineno, pass_id,
+                        "qmeta refs.pop(0) must be the FIRST pop in "
+                        "the kernel — the packed block is appended "
+                        "directly after the unconditional inputs, so "
+                        "popping it later misaligns every ref",
+                    ))
+    return out
+
+
+def check(project: Project, reg=None) -> List[Finding]:
+    reg = reg or default_registry
+    return _check_pack_layout(project, reg) + _check_qmeta(project, reg)

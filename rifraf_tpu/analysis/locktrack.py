@@ -1,0 +1,263 @@
+"""Pass 6 (runtime half): the LockTracker harness.
+
+Where the static pass (races.py) proves every mutation SITE sits under
+a ``with self.<lock>``, this harness proves the discipline holds at
+RUNTIME under real thread interleavings — including mutations the
+static pass cannot see (dict/list item writes through a local alias, a
+helper called with the lock supposedly held).
+
+Usage (tests/test_analysis_races.py):
+
+    tracker = LockTracker()
+    track_instance(stats, tracker)        # spec from the registry
+    ... hammer from N threads ...
+    assert tracker.violations == []
+
+``track_instance``:
+
+- replaces each declared lock attribute with a tracked wrapper
+  (``TrackedLock`` for Lock/RLock, ``TrackedCondition`` for Condition)
+  that records the owning thread between acquire and release;
+- wraps each shared mutable container attribute (dict/list/set/deque
+  not in the allowlist) in a guard proxy whose mutating methods assert
+  one of the instance's tracked locks is held by the CURRENT thread —
+  ``__setattr__`` interception alone cannot see item mutation;
+- swaps the instance's ``__class__`` to a subclass whose
+  ``__setattr__`` asserts lock ownership on every non-allowlisted
+  attribute rebind, and records (thread, attr) for allowlisted handoff
+  attributes so a test can assert the single-writer/ownership pattern
+  (e.g. ``Worker.inflight`` written by the worker thread only while it
+  is alive).
+
+The detector is DETERMINISTIC in a way timing-based race tests are
+not: any unguarded mutation is recorded on every schedule, not only on
+the schedules where two threads actually collide.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from . import registry as default_registry
+
+
+@dataclass
+class Violation:
+    cls: str
+    attr: str
+    op: str
+    thread: str
+
+    def __str__(self) -> str:
+        return (f"{self.cls}.{self.attr}: unguarded {self.op} from "
+                f"thread '{self.thread}'")
+
+
+class LockTracker:
+    """Violation sink + write journal shared by every tracked object."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.violations: List[Violation] = []
+        # (cls, attr) -> ordered list of writer thread names, for
+        # ownership/handoff assertions on allowlisted attributes
+        self.writes: Dict[Tuple[str, str], List[str]] = {}
+
+    def record_violation(self, cls: str, attr: str, op: str) -> None:
+        v = Violation(cls, attr, op, threading.current_thread().name)
+        with self._mu:
+            self.violations.append(v)
+
+    def record_write(self, cls: str, attr: str) -> None:
+        name = threading.current_thread().name
+        with self._mu:
+            self.writes.setdefault((cls, attr), []).append(name)
+
+
+class TrackedLock:
+    """threading.Lock wrapper recording the owning thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.owner: Optional[int] = None
+
+    def acquire(self, *a, **k) -> bool:
+        got = self._lock.acquire(*a, **k)
+        if got:
+            self.owner = threading.get_ident()
+        return got
+
+    def release(self) -> None:
+        self.owner = None
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def held_by_me(self) -> bool:
+        return self.owner == threading.get_ident()
+
+
+class TrackedCondition:
+    """threading.Condition wrapper; ownership is cleared for the
+    duration of a wait (the condition releases its lock there)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.owner: Optional[int] = None
+
+    def acquire(self, *a, **k) -> bool:
+        got = self._cv.acquire(*a, **k)
+        if got:
+            self.owner = threading.get_ident()
+        return got
+
+    def release(self) -> None:
+        self.owner = None
+        self._cv.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout=None):
+        self.owner = None
+        try:
+            return self._cv.wait(timeout)
+        finally:
+            self.owner = threading.get_ident()
+
+    def wait_for(self, predicate, timeout=None):
+        self.owner = None
+        try:
+            return self._cv.wait_for(predicate, timeout)
+        finally:
+            self.owner = threading.get_ident()
+
+    def notify(self, n=1):
+        self._cv.notify(n)
+
+    def notify_all(self):
+        self._cv.notify_all()
+
+    def held_by_me(self) -> bool:
+        return self.owner == threading.get_ident()
+
+
+def _held_any(locks) -> bool:
+    return any(lk.held_by_me() for lk in locks)
+
+
+def _make_guard(value, locks, tracker: LockTracker, cls: str, attr: str):
+    """A guard-proxy subclass instance mirroring ``value``; mutating
+    methods record a violation when no tracked lock is held."""
+
+    def checked(op_name, fn):
+        def op(self, *a, **k):
+            if not _held_any(locks):
+                tracker.record_violation(cls, attr, f"{op_name}()")
+            return fn(self, *a, **k)
+        op.__name__ = op_name
+        return op
+
+    if isinstance(value, dict):
+        ops = ("__setitem__", "__delitem__", "pop", "popitem", "clear",
+               "update", "setdefault")
+        base, init = dict, (value,)
+    elif isinstance(value, deque):
+        ops = ("append", "appendleft", "extend", "extendleft", "pop",
+               "popleft", "remove", "clear", "__setitem__",
+               "__delitem__")
+        base, init = deque, (value, value.maxlen)
+    elif isinstance(value, list):
+        ops = ("append", "extend", "insert", "pop", "remove", "clear",
+               "sort", "reverse", "__setitem__", "__delitem__",
+               "__iadd__")
+        base, init = list, (value,)
+    elif isinstance(value, set):
+        ops = ("add", "discard", "remove", "pop", "clear", "update",
+               "difference_update", "intersection_update",
+               "symmetric_difference_update")
+        base, init = set, (value,)
+    else:
+        return value
+    ns = {name: checked(name, getattr(base, name)) for name in ops}
+    proxy_cls = type(f"Guarded{base.__name__.capitalize()}", (base,), ns)
+    return proxy_cls(*init)
+
+
+def _spec_for(obj, reg) -> Optional[dict]:
+    for (_rel, cls_name), spec in reg.SHARED_STATE.items():
+        if type(obj).__name__ == cls_name or any(
+            c.__name__ == cls_name for c in type(obj).__mro__
+        ):
+            return spec
+    return None
+
+
+def track_instance(obj, tracker: LockTracker, spec: Optional[dict] = None,
+                   reg=None):
+    """Instrument one live instance against its registry spec (or an
+    explicit ``spec`` with the same shape). Returns ``obj``."""
+    reg = reg or default_registry
+    if spec is None:
+        spec = _spec_for(obj, reg)
+    if spec is None:
+        raise KeyError(
+            f"{type(obj).__name__} has no registry.SHARED_STATE entry"
+        )
+    cls_name = type(obj).__name__
+    unguarded_ok = set(spec.get("unguarded_ok", {}))
+    lock_names = tuple(spec["locks"])
+
+    # 1. swap the declared locks for tracked ones
+    tracked_locks = []
+    for name in lock_names:
+        current = object.__getattribute__(obj, name)
+        wrapper = (TrackedCondition()
+                   if isinstance(current, threading.Condition)
+                   else TrackedLock())
+        object.__setattr__(obj, name, wrapper)
+        tracked_locks.append(wrapper)
+
+    # 2. wrap shared mutable containers in guard proxies
+    for name, value in list(vars(obj).items()):
+        if name in lock_names or name in unguarded_ok:
+            continue
+        if isinstance(value, (dict, list, set, deque)):
+            object.__setattr__(
+                obj, name,
+                _make_guard(value, tracked_locks, tracker, cls_name,
+                            name),
+            )
+
+    # 3. subclass swap: assert ownership on attribute rebinds
+    base = type(obj)
+
+    def __setattr__(self, name, value):
+        if name in unguarded_ok:
+            tracker.record_write(cls_name, name)
+        elif tracked_locks and not _held_any(tracked_locks):
+            tracker.record_violation(cls_name, name, "attribute rebind")
+        elif not tracked_locks:
+            # lock-free class: every non-allowlisted rebind is a
+            # violation — the registry says nothing else is shared
+            tracker.record_violation(cls_name, name, "attribute rebind")
+        object.__setattr__(self, name, value)
+
+    tracked_cls = type(f"Tracked{cls_name}", (base,),
+                       {"__setattr__": __setattr__})
+    object.__setattr__(obj, "__class__", tracked_cls)
+    return obj

@@ -1,0 +1,140 @@
+"""Pass 6 (static half): serve lock discipline.
+
+The serving stack runs caller, batcher, worker-N, and supervisor
+threads against shared state. The discipline, declared per class in
+``registry.SHARED_STATE``: every mutation of a shared attribute happens
+inside ``with self.<lock>`` for one of the class's declared locks —
+except attributes explicitly allowlisted as lock-free single-writer /
+GIL-atomic handoffs (each with a written reason) and private helpers
+declared ``caller_locked`` (all call sites hold the lock).
+
+Mutations the pass sees: attribute rebinds (``self.x = ...``, including
+tuple-unpack targets and augmented assigns), item writes
+(``self.x[k] = ...``, ``del self.x[k]``), and mutating container-method
+calls (``self.x.append(...)`` etc., registry.MUTATOR_METHODS).
+``__init__`` is exempt (no other thread can hold the instance yet).
+
+The runtime half (``locktrack.py``) enforces the same table with real
+threads; this half catches the violations a stress test may never
+schedule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from . import registry as default_registry
+from .common import (
+    Finding,
+    Project,
+    enclosing_function,
+    in_with_lock,
+)
+
+
+def _self_attr_of_target(tgt: ast.AST) -> Optional[Tuple[str, str]]:
+    """('attr', kind) when ``tgt`` writes through self: rebinds
+    (self.x), item writes (self.x[k]), nested tuple targets."""
+    if isinstance(tgt, ast.Attribute) and \
+            isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+        return tgt.attr, "rebind"
+    if isinstance(tgt, ast.Subscript) and \
+            isinstance(tgt.value, ast.Attribute) and \
+            isinstance(tgt.value.value, ast.Name) and \
+            tgt.value.value.id == "self":
+        return tgt.value.attr, "item write"
+    return None
+
+
+def _method_of(node: ast.AST, cls: ast.ClassDef) -> Optional[str]:
+    fn = enclosing_function(node)
+    while fn is not None and fn not in cls.body:
+        fn = enclosing_function(fn)
+    return fn.name if fn is not None else None
+
+
+def _check_class(sf, cls: ast.ClassDef, spec, reg,
+                 out: List[Finding]) -> None:
+    pass_id = "races"
+    mutators = getattr(reg, "MUTATOR_METHODS",
+                       default_registry.MUTATOR_METHODS)
+    locks = tuple(spec["locks"])
+    unguarded_ok = spec.get("unguarded_ok", {})
+    caller_locked = spec.get("caller_locked", {})
+    for attr, reason in list(unguarded_ok.items()) + \
+            list(caller_locked.items()):
+        if not (reason or "").strip():
+            out.append(Finding(
+                sf.rel, cls.lineno, pass_id,
+                f"allowlist entry '{attr}' on {cls.name} has no reason",
+            ))
+
+    def flag(node: ast.AST, attr: str, kind: str) -> None:
+        method = _method_of(node, cls)
+        if method in ("__init__",) or method is None:
+            return
+        if method in caller_locked:
+            return
+        if attr in unguarded_ok:
+            return
+        if locks and in_with_lock(node, locks):
+            return
+        have = (f"hold one of {list(locks)}" if locks
+                else "declare it in the registry allowlist")
+        out.append(Finding(
+            sf.rel, node.lineno, pass_id,
+            f"unguarded {kind} of shared attribute "
+            f"'{cls.name}.{attr}' in {method}(); {have} or allowlist "
+            "it with a reason",
+        ))
+
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets = []
+            for t in node.targets:
+                targets.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+            for t in targets:
+                hit = _self_attr_of_target(t)
+                if hit:
+                    flag(node, *hit)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if isinstance(node, ast.AnnAssign) and node.value is None:
+                continue
+            hit = _self_attr_of_target(node.target)
+            if hit:
+                flag(node, hit[0], "rebind")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                hit = _self_attr_of_target(t)
+                if hit:
+                    flag(node, hit[0], "delete")
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in mutators
+                    and isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id == "self"):
+                flag(node, f.value.attr, f"mutating call .{f.attr}()")
+
+
+def check(project: Project, reg=None) -> List[Finding]:
+    reg = reg or default_registry
+    out: List[Finding] = []
+    for (rel, cls_name), spec in reg.SHARED_STATE.items():
+        sf = project.file(rel)
+        if sf is None:
+            out.append(Finding(rel, 1, "races",
+                               f"shared-state file '{rel}' missing"))
+            continue
+        cls = sf.find_class(cls_name)
+        if cls is None:
+            out.append(Finding(
+                sf.rel, 1, "races",
+                f"registered shared-state class '{cls_name}' not "
+                f"found in '{rel}'",
+            ))
+            continue
+        _check_class(sf, cls, spec, reg, out)
+    return out

@@ -1,0 +1,385 @@
+"""The invariant registry: rifraf-tpu's cross-cutting contracts AS DATA.
+
+Every pass in ``rifraf_tpu.analysis`` is driven by the declarations in
+this module, so adding a routing knob, a fingerprint field, an env
+gate, or a thread-shared class means editing ONE table here — and the
+registry self-checks force the edit to be explicit: each program
+factory and fingerprint builder must account for EVERY declared knob,
+either by carrying it or by an exemption with a written reason.
+``docs/analysis.md`` documents each table and how to extend it.
+
+Nothing here imports the rest of the package (see common.py's
+stdlib-only rule): the registry describes the code, it never runs it.
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------------------
+# Pass 1: cache-key completeness
+# --------------------------------------------------------------------
+# The knobs that ROUTE a compiled program: two calls differing in any
+# of these must hit different executables, so every lru_cache'd program
+# factory must carry each knob in its parameter list (= its cache key)
+# or be exempt with a reason.
+PROGRAM_IDENTITY_KNOBS = (
+    "band_dtype",   # bf16/f32 band-store precision (PR 10)
+    "input_enc",    # f32 vs packed 2-bit/int8 input encoding (PR 13)
+    "impl",         # fused Pallas implementation: "mega" | "split"
+    "want_edge",    # edge-hit statistics output (adaptive band growth)
+    "want_guard",   # integrity guard-word output (PR 11)
+)
+
+# Parameter names that satisfy a knob (a factory may spell the edge
+# knob `use_edits`: the stage runners' edit-table variant implies the
+# edge-statistics path).
+KNOB_ALIASES = {
+    "band_dtype": ("band_dtype",),
+    "input_enc": ("input_enc",),
+    "impl": ("impl",),
+    "want_edge": ("want_edge", "use_edits"),
+    "want_guard": ("want_guard",),
+}
+
+# Files scanned for lru_cache'd factories. EVERY lru_cache'd function
+# found here must have a registry entry below — an unregistered one is
+# a finding, so a new factory cannot land without declaring its keys.
+FACTORY_SCAN = (
+    "rifraf_tpu/engine/realign.py",
+    "rifraf_tpu/parallel/sweep_sharded.py",
+    "rifraf_tpu/serve",
+)
+
+# (file, function) -> {"required": knobs..., "exempt": {knob: reason}}.
+# required + exempt must cover PROGRAM_IDENTITY_KNOBS exactly.
+_XLA_EXEMPT = {
+    "impl": "XLA scan path has a single implementation; `impl` routes "
+            "only the Pallas kernels",
+    "input_enc": "the XLA path consumes exact f32 inputs; BatchAligner "
+                 "routes packed encodings to the Pallas runners only",
+}
+PROGRAM_FACTORIES = {
+    ("rifraf_tpu/engine/realign.py", "_pallas_frame_runner"): {
+        "required": ("band_dtype", "input_enc", "impl"),
+        "exempt": {
+            "want_edge": "frame realignment computes no traceback "
+                         "statistics; edge hits are sweep-stage outputs",
+            "want_guard": "guard words are sweep/serve integrity "
+                          "outputs; the frame loop never packs them",
+        },
+    },
+    ("rifraf_tpu/engine/realign.py", "_xla_frame_runner"): {
+        "required": ("band_dtype",),
+        "exempt": dict(
+            _XLA_EXEMPT,
+            want_edge="frame realignment computes no traceback "
+                      "statistics; edge hits are sweep-stage outputs",
+            want_guard="guard words are sweep/serve integrity outputs; "
+                       "the frame loop never packs them",
+        ),
+    },
+    ("rifraf_tpu/engine/realign.py", "_pallas_stage_runner"): {
+        "required": ("band_dtype", "input_enc", "impl", "want_edge"),
+        "exempt": {
+            "want_guard": "the realign driver verifies guards in its "
+                          "own adapt rounds, never in the stage loop",
+        },
+    },
+    ("rifraf_tpu/engine/realign.py", "_xla_stage_runner"): {
+        "required": ("band_dtype", "want_edge"),
+        "exempt": dict(
+            _XLA_EXEMPT,
+            want_guard="the realign driver verifies guards in its own "
+                       "adapt rounds, never in the stage loop",
+        ),
+    },
+    ("rifraf_tpu/parallel/sweep_sharded.py", "_adapt_program"): {
+        "required": ("band_dtype", "input_enc", "want_edge",
+                     "want_guard"),
+        "exempt": {
+            "impl": "the fused impl is process-global "
+                    "(RIFRAF_TPU_FUSED_IMPL read at trace time); the "
+                    "inner realign factories carry it where both impls "
+                    "can coexist",
+        },
+    },
+    ("rifraf_tpu/parallel/sweep_sharded.py", "_stage_program"): {
+        "required": ("band_dtype", "input_enc", "want_edge"),
+        "exempt": {
+            "impl": "the fused impl is process-global "
+                    "(RIFRAF_TPU_FUSED_IMPL read at trace time); the "
+                    "inner realign factories carry it where both impls "
+                    "can coexist",
+            "want_guard": "guard flags are produced by the adapt-round "
+                          "programs only; the INIT stage never packs "
+                          "them",
+        },
+    },
+    ("rifraf_tpu/parallel/sweep_sharded.py", "_seg_adapt_program"): {
+        "required": ("band_dtype", "input_enc", "want_edge",
+                     "want_guard"),
+        "exempt": {
+            "impl": "the fused impl is process-global "
+                    "(RIFRAF_TPU_FUSED_IMPL read at trace time); the "
+                    "inner realign factories carry it where both impls "
+                    "can coexist",
+        },
+    },
+    ("rifraf_tpu/parallel/sweep_sharded.py", "_seg_stage_program"): {
+        "required": ("band_dtype", "input_enc", "want_edge"),
+        "exempt": {
+            "impl": "the fused impl is process-global "
+                    "(RIFRAF_TPU_FUSED_IMPL read at trace time); the "
+                    "inner realign factories carry it where both impls "
+                    "can coexist",
+            "want_guard": "guard flags are produced by the adapt-round "
+                          "programs only; the INIT stage never packs "
+                          "them",
+        },
+    },
+}
+
+# --------------------------------------------------------------------
+# Pass 2: fingerprint coverage
+# --------------------------------------------------------------------
+# Fields a resumable-journal fingerprint must fold in: anything that
+# changes results (or changes which checks ran) between the run that
+# wrote the journal and the run resuming it.
+FINGERPRINT_KNOBS = (
+    "band_dtype",
+    "band_growth",
+    "input_enc",
+    "guard",
+    "verify_fraction",
+    "max_iters",
+    "min_dist",
+    "bandwidth_pvalue",
+    "proposals",
+    "scores",
+    "content",
+)
+
+# Identifiers (parameter names, attribute names, or string-literal part
+# labels) that count as folding a knob into the digest.
+FINGERPRINT_ALIASES = {
+    "band_dtype": ("band_dtype",),
+    "band_growth": ("band_growth",),
+    "input_enc": ("input_enc",),
+    "guard": ("guard",),
+    "verify_fraction": ("verify_fraction",),
+    "max_iters": ("max_iters",),
+    "min_dist": ("min_dist",),
+    "bandwidth_pvalue": ("bandwidth_pvalue",),
+    "proposals": ("do_alignment_proposals", "alignment_proposals"),
+    "scores": ("scores",),
+    # a content signal: the sweep digests every cluster's reads, the
+    # spool digests the file head
+    "content": ("_content_digest", "sha256", "head"),
+}
+
+FINGERPRINT_BUILDERS = {
+    ("rifraf_tpu/parallel/sweep_sharded.py", "_journal_fingerprint"): {
+        "required": ("band_dtype", "band_growth", "input_enc", "guard",
+                     "verify_fraction", "max_iters", "min_dist",
+                     "bandwidth_pvalue", "proposals", "content"),
+        "exempt": {
+            "scores": "per-read score parameters are hashed inside "
+                      "_content_digest's per-read tuples",
+        },
+    },
+    ("rifraf_tpu/cli/serve.py", "_spool_fingerprint"): {
+        "required": ("band_dtype", "band_growth", "input_enc", "guard",
+                     "verify_fraction", "max_iters", "proposals",
+                     "scores", "content"),
+        "exempt": {
+            "min_dist": "the serve CLI exposes no flag; every spool "
+                        "run uses the pinned ServeConfig default",
+            "bandwidth_pvalue": "the serve CLI exposes no flag; every "
+                                "spool run uses the pinned ServeConfig "
+                                "default",
+        },
+    },
+}
+
+# --------------------------------------------------------------------
+# Pass 3: dtype discipline (store narrow, accumulate wide)
+# --------------------------------------------------------------------
+DTYPE_SCAN = ("rifraf_tpu/ops",)
+
+# dtypes that may only be STORED, never accumulated in
+NARROW_DTYPES = ("bfloat16", "int8", "float16", "uint8")
+# dtypes whose cast re-widens a narrow value
+WIDE_DTYPES = ("float32", "int32", "float64", "int64")
+# functions whose RESULT is a narrow dtype object (so `.astype(x)`
+# where x came from one of these is a narrowing cast)
+NARROW_RESOLVERS = ("band_store_dtype",)
+# call targets that accumulate (max-plus recurrence, reductions) —
+# feeding a narrow value into one of these without an intervening
+# re-widen is the violation
+ACCUMULATE_CALLS = (
+    "max", "maximum", "min", "minimum", "sum", "cumsum", "dot",
+    "matmul", "logaddexp", "logsumexp10", "summax", "add", "prod",
+    "mean",
+)
+
+# --------------------------------------------------------------------
+# Pass 4: packed-array layout contracts
+# --------------------------------------------------------------------
+# Canonical pack_layout section order: (name, gating flags). The guard
+# section must stay LAST so integrity-off layouts (and every pre-guard
+# offset of integrity-on layouts) stay byte-identical.
+PACK_LAYOUT_FILE = "rifraf_tpu/ops/fused.py"
+PACK_LAYOUT_FUNC = "pack_layout"
+PACK_LAYOUT = (
+    ("total", ()),
+    ("scores", ()),
+    ("n_errors", ("want_stats",)),
+    ("edits", ("want_stats",)),
+    ("edge_hits", ("want_stats", "want_edge")),
+    ("sub", ("want_tables",)),
+    ("ins", ("want_tables",)),
+    ("del", ("want_tables",)),
+    ("guard", ("want_guard",)),
+)
+PACK_TAIL = "guard"
+
+# qmeta discipline (packed input encoding, PR 13): the [8, 1, 128]
+# dequant-row block is appended to the kernel inputs ONLY under an
+# `input_enc == "packed"` gate, with its BlockSpec appended in the same
+# gated block — and inside the kernels it must be popped FIRST from
+# *refs, before any other conditional or output ref.
+QMETA_FILES = (
+    "rifraf_tpu/ops/fill_pallas.py",
+    "rifraf_tpu/ops/fused_pallas.py",
+    "rifraf_tpu/ops/dense_pallas.py",
+)
+QMETA_GATE_NAME = "input_enc"
+QMETA_GATE_VALUE = "packed"
+
+# --------------------------------------------------------------------
+# Pass 5: env-gate registry
+# --------------------------------------------------------------------
+# Every RIFRAF_TPU_* name the code mentions, with the doc file that
+# explains it. The pass scans ENV_SCAN for unregistered names and
+# verifies each anchor file exists and mentions the name.
+ENV_GATES = {
+    "RIFRAF_TPU_FUSED_IMPL": "docs/api.md",
+    "RIFRAF_TPU_STATS_IMPL": "docs/api.md",
+    "RIFRAF_TPU_AOT_CACHE": "docs/api.md",
+    "RIFRAF_TPU_SEGMENT_PACK": "docs/api.md",
+    "RIFRAF_TPU_HBM_GBPS": "docs/api.md",
+    "RIFRAF_TPU_VPU_TOPS": "docs/api.md",
+    "RIFRAF_TPU_ICI_GBPS": "docs/api.md",
+    "RIFRAF_TPU_FAULTS": "docs/serving.md",
+    "RIFRAF_TPU_PALLAS_INTERPRET": "docs/analysis.md",
+    "RIFRAF_TPU_CACHE": "docs/analysis.md",
+    "RIFRAF_TPU_HBM_BUDGET": "docs/analysis.md",
+    "RIFRAF_TPU_DEBUG": "docs/analysis.md",
+    "RIFRAF_TPU_BAND_DTYPE": "docs/analysis.md",
+}
+# the analysis package itself is excluded: its registry and fixtures
+# NAME the gates without reading them
+ENV_SCAN = ("rifraf_tpu", "bench.py", "tests")
+ENV_SKIP = ("rifraf_tpu/analysis",)
+
+# --------------------------------------------------------------------
+# Pass 6: serve lock discipline (static half; locktrack.py is the
+# runtime half and reads the same table)
+# --------------------------------------------------------------------
+# (file, class) -> {"locks": guarding attrs, "unguarded_ok":
+# {attr: reason} for deliberately lock-free single-writer/GIL-atomic
+# handoffs, "caller_locked": {method: reason} for private helpers
+# whose callers all hold the lock}.
+SHARED_STATE = {
+    ("rifraf_tpu/utils/timers.py", "Timers"): {
+        "locks": ("_lock",),
+        "unguarded_ok": {},
+        "caller_locked": {},
+    },
+    ("rifraf_tpu/serve/stats.py", "ServerStats"): {
+        "locks": ("_lock",),
+        "unguarded_ok": {},
+        "caller_locked": {},
+    },
+    ("rifraf_tpu/serve/quarantine.py", "DeviceScoreboard"): {
+        "locks": ("_lock",),
+        "unguarded_ok": {},
+        "caller_locked": {
+            "_get": "lazy-init helper; every caller holds _lock",
+        },
+    },
+    ("rifraf_tpu/serve/batcher.py", "MicroBatcher"): {
+        "locks": ("_lock",),
+        "unguarded_ok": {},
+        "caller_locked": {
+            "_lane_demand": "pure read helper; both callers (add, the "
+                            "flush policy) hold _lock",
+        },
+    },
+    ("rifraf_tpu/cli/serve.py", "_Emitter"): {
+        "locks": ("lock", "_cv"),
+        "unguarded_ok": {
+            "journal": "io.journal.Journal serializes internally "
+                       "(its own _lock around every append)",
+        },
+        "caller_locked": {},
+    },
+    ("rifraf_tpu/serve/worker.py", "Worker"): {
+        "locks": (),
+        "unguarded_ok": {
+            "last_beat": "monotonic heartbeat float; single writer "
+                         "(the worker thread), the supervisor only "
+                         "compares staleness",
+            "busy": "bool flag, single writer; a stale supervisor "
+                    "read delays a scale decision by one tick at most",
+            "inflight": "rebind-only handoff (the list object is "
+                        "replaced atomically under the GIL); "
+                        "take_inflight() swaps it out only after the "
+                        "worker thread is dead",
+            "draining": "written once by the supervisor; the worker "
+                        "loop polls it",
+            "drained": "written once by the worker on clean exit; "
+                       "read post-mortem by the supervisor",
+            "_last_probe": "probe rate-limit timestamp; only the "
+                           "supervisor-driven probe path writes it",
+        },
+        "caller_locked": {},
+    },
+    ("rifraf_tpu/serve/server.py", "ConsensusServer"): {
+        "locks": ("_outstanding_lock",),
+        "unguarded_ok": {
+            "_closed": "set once by close(); racy readers fail over "
+                       "to the closed path on their next submit",
+            "_unhealthy": "set once by the supervisor's terminal "
+                          "transition; readers degrade gracefully",
+            "_worker_restarts": "supervisor-thread-only counter",
+            "_batcher_restarts": "supervisor-thread-only counter",
+            "_last_crash": "supervisor-thread-only backoff timestamp",
+            "_last_scale": "supervisor-thread-only elastic timestamp",
+            "_last_active": "supervisor-thread-only idle timestamp",
+            "_last_stall_beat": "supervisor-thread-only stall map",
+            "_batcher_thread": "rebound by start() and the "
+                               "supervisor's restart path only",
+            "_supervisor_thread": "rebound by start() only",
+            "_worker_threads": "slot rebinds happen on the "
+                               "supervisor thread (start() runs "
+                               "before any other thread exists)",
+            "_workers": "slot rebinds happen on the supervisor "
+                        "thread (start() runs before any other "
+                        "thread exists)",
+            "_draining": "supervisor-thread-only elastic set",
+            "_retired": "supervisor-thread-only elastic set",
+            "_parked": "supervisor-thread-only probe set",
+            "_batcher": "MicroBatcher serializes internally (its own "
+                        "SHARED_STATE entry enforces _lock)",
+        },
+        "caller_locked": {},
+    },
+}
+
+# mutating container-method names the static race pass treats as
+# writes when called on a self attribute
+MUTATOR_METHODS = (
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "discard", "add", "clear", "update",
+    "setdefault",
+)

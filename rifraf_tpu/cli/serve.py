@@ -498,6 +498,7 @@ def _spool_fingerprint(path: str, args, config: ServeConfig) -> str:
     no longer matches its stale journal, so its (possibly different)
     requests are re-served instead of silently skipped."""
     from ..io.journal import fingerprint
+    from ..utils.fprint import fold_nondefault
 
     head = b""
     try:
@@ -505,17 +506,19 @@ def _spool_fingerprint(path: str, args, config: ServeConfig) -> str:
             head = fh.read(65536)
     except OSError:
         pass
-    # input_enc folds in only when non-default so spool journals
-    # written before the knob existed stay resumable under f32
-    enc_parts = (
-        ["input_enc", config.input_enc]
-        if config.input_enc != "f32" else []
-    )
+    # the encoding and integrity knobs fold in only when non-default so
+    # spool journals written before each knob existed stay resumable;
+    # guard/verify_fraction are CLI-settable and change which checks a
+    # resumed run performs, so they are part of the config identity
     return fingerprint(
         os.path.basename(path), config.scores, args.phred_cap,
         args.deadline_ms, args.max_iters, args.alignment_proposals,
         hashlib.sha256(head).hexdigest(),
-        config.band_dtype, config.band_growth, *enc_parts,
+        config.band_dtype, config.band_growth,
+        *fold_nondefault("input_enc", config.input_enc, "f32"),
+        *fold_nondefault("guard", bool(config.guard), False),
+        *fold_nondefault("verify_fraction", config.verify_fraction,
+                         0.0),
     )
 
 

@@ -76,6 +76,7 @@ from ..engine.bandgrowth import (
     grow_bandwidths,
 )
 from ..models.sequences import ReadScores, batch_reads
+from ..utils.fprint import fold_nondefault
 from ..utils.mathops import logsumexp10, poisson_cquantile
 from ..utils.shapes import LANES, pack_segments
 from ..utils.shapes import bucket as _bucket
@@ -292,6 +293,36 @@ def _content_digest(clusters: Sequence[Sequence[ReadScores]]) -> str:
             h.update(b"\x00")
         h.update(b"\x01")
     return h.hexdigest()[:32]
+
+
+def _journal_fingerprint(G, infos, clusters, max_iters, min_dist,
+                         bandwidth_pvalue, len_bucket, cluster_chunk,
+                         scheduler, read_bucket, band_bucket,
+                         do_alignment_proposals, lane_target,
+                         segment_pack, segment_align, band_dtype,
+                         band_growth, guard, verify_fraction,
+                         input_enc) -> str:
+    """The sweep journal's resume fingerprint: every knob that changes
+    results (or which integrity checks ran) between the run that wrote
+    the journal and the run resuming it, plus the cluster content
+    digest. The integrity knobs (guard, verify_fraction) and the input
+    encoding fold in only when non-default (utils.fold_nondefault) so
+    journals minted before each knob existed stay resumable — a guard
+    or verify setting never changes results, but resuming a guarded run
+    unguarded would skip its checks silently."""
+    from ..io.journal import fingerprint
+
+    return fingerprint(
+        G, [tuple(i) for i in infos], _content_digest(clusters),
+        max_iters, min_dist,
+        bandwidth_pvalue, len_bucket, cluster_chunk, scheduler,
+        read_bucket, band_bucket, do_alignment_proposals,
+        lane_target, segment_pack, segment_align,
+        band_dtype, band_growth,
+        *fold_nondefault("guard", bool(guard), False),
+        *fold_nondefault("verify_fraction", verify_fraction, 0.0),
+        *fold_nondefault("input_enc", input_enc, "f32"),
+    )
 
 
 def bucket_key(
@@ -1351,29 +1382,16 @@ def sweep_clusters_sharded(
     journal = None
     done_tasks: set = set()
     if journal_path:
-        from ..io.journal import fingerprint, open_resumable
+        from ..io.journal import open_resumable
         from ..utils.constants import encode_seq
 
-        # integrity knobs fold in only when ACTIVE so default-path
-        # journals keep their pre-integrity fingerprints (a guard or
-        # verify setting never changes results, but resuming a guarded
-        # run unguarded would skip its checks silently)
-        integrity_parts = []
-        if guard:
-            integrity_parts += ["guard", True]
-        if verify_fraction > 0.0:
-            integrity_parts += ["verify_fraction", verify_fraction]
-        # like the integrity knobs, the encoding folds in only when
-        # non-default so pre-existing f32 journals stay resumable
-        if input_enc != "f32":
-            integrity_parts += ["input_enc", input_enc]
-        fp = fingerprint(
-            G, [tuple(i) for i in infos], _content_digest(clusters),
-            max_iters, min_dist,
+        fp = _journal_fingerprint(
+            G, infos, clusters, max_iters, min_dist,
             bandwidth_pvalue, len_bucket, cluster_chunk, scheduler,
             read_bucket, band_bucket, do_alignment_proposals,
             lane_target, segment_pack, segment_align,
-            band_dtype, band_growth, *integrity_parts,
+            band_dtype, band_growth, guard, verify_fraction,
+            input_enc,
         )
         journal, prior = open_resumable(
             journal_path,

@@ -29,6 +29,7 @@ waste at offline-sweep levels instead of pad-to-global-maxima.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..parallel.sweep_sharded import SEG_TMAX_MAX, segment_pack_enabled
@@ -59,10 +60,16 @@ class MicroBatcher:
     def __init__(self, config: ServeConfig):
         self.config = config
         self.segment_pack = resolve_segment_pack(config)
+        # the batcher thread owns the flush policy, but depth() is read
+        # by the caller path (queue_depth) and the supervisor's elastic
+        # tick — iterating _pending while the batcher mutates it raises
+        # RuntimeError, so every access goes through _lock
+        self._lock = threading.Lock()
         self._pending: Dict[Tuple, List[Request]] = {}
 
     def depth(self) -> int:
-        return sum(len(v) for v in self._pending.values())
+        with self._lock:
+            return sum(len(v) for v in self._pending.values())
 
     def _group_key(self, req: Request) -> Tuple:
         if self.segment_pack and segment_eligible(
@@ -89,14 +96,15 @@ class MicroBatcher:
         (``max_batch``) or by lane capacity (``lane_target`` read
         lanes, post-packing demand) — else None."""
         key = self._group_key(req)
-        bucket = self._pending.setdefault(key, [])
-        bucket.append(req)
-        lane_target = self.config.lane_target
-        if len(bucket) >= self.config.max_batch or (
-            lane_target > 0
-            and self._lane_demand(key, bucket) >= lane_target
-        ):
-            return self._pending.pop(key)
+        with self._lock:
+            bucket = self._pending.setdefault(key, [])
+            bucket.append(req)
+            lane_target = self.config.lane_target
+            if len(bucket) >= self.config.max_batch or (
+                lane_target > 0
+                and self._lane_demand(key, bucket) >= lane_target
+            ):
+                return self._pending.pop(key)
         return None
 
     def due(self, now: float) -> List[List[Request]]:
@@ -104,13 +112,15 @@ class MicroBatcher:
         max_wait = self.config.max_wait_ms / 1e3
         margin = self.config.deadline_margin_ms / 1e3
         flushes = []
-        for key in list(self._pending):
-            bucket = self._pending[key]
-            oldest_wait = now - bucket[0].t_submit
-            deadlines = [r.deadline for r in bucket if r.deadline is not None]
-            at_risk = deadlines and min(deadlines) - now <= margin
-            if oldest_wait >= max_wait or at_risk:
-                flushes.append(self._pending.pop(key))
+        with self._lock:
+            for key in list(self._pending):
+                bucket = self._pending[key]
+                oldest_wait = now - bucket[0].t_submit
+                deadlines = [r.deadline for r in bucket
+                             if r.deadline is not None]
+                at_risk = deadlines and min(deadlines) - now <= margin
+                if oldest_wait >= max_wait or at_risk:
+                    flushes.append(self._pending.pop(key))
         return flushes
 
     def next_due(self, now: float) -> Optional[float]:
@@ -120,18 +130,20 @@ class MicroBatcher:
         max_wait = self.config.max_wait_ms / 1e3
         margin = self.config.deadline_margin_ms / 1e3
         t_next = None
-        for bucket in self._pending.values():
-            t = bucket[0].t_submit + max_wait
-            for r in bucket:
-                if r.deadline is not None:
-                    t = min(t, r.deadline - margin)
-            t_next = t if t_next is None else min(t_next, t)
+        with self._lock:
+            for bucket in self._pending.values():
+                t = bucket[0].t_submit + max_wait
+                for r in bucket:
+                    if r.deadline is not None:
+                        t = min(t, r.deadline - margin)
+                t_next = t if t_next is None else min(t_next, t)
         if t_next is None:
             return None
         return max(t_next - now, 0.0)
 
     def drain(self) -> List[List[Request]]:
         """Flush everything (shutdown)."""
-        out = list(self._pending.values())
-        self._pending.clear()
+        with self._lock:
+            out = list(self._pending.values())
+            self._pending.clear()
         return out

@@ -16,6 +16,7 @@ from .phred import (
     cap_phreds,
     normalize,
 )
+from .fprint import fold_nondefault
 from .mathops import logsumexp10, summax
 from .shapes import bucket, pow2_bucket
 
@@ -34,6 +35,7 @@ __all__ = [
     "phred_to_p",
     "cap_phreds",
     "normalize",
+    "fold_nondefault",
     "logsumexp10",
     "summax",
     "bucket",

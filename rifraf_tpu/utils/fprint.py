@@ -1,0 +1,29 @@
+"""Fingerprint part helpers shared by every resumable-journal surface.
+
+The sweep journal (parallel.sweep_sharded) and the serve spool journal
+(cli.serve) both need the same backward-compatibility move when a new
+knob joins their fingerprint: fold it in ONLY when it differs from the
+default, so every journal minted before the knob existed keeps its
+digest and stays resumable. Before this module each site re-implemented
+the conditional inline; centralizing it gives the fingerprint-coverage
+lint pass (``rifraf_tpu.analysis``, pass ``fingerprints``) one named
+idiom to look for and keeps the two digests drifting in lockstep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+def fold_nondefault(name: str, value: Any, default: Any) -> List[Any]:
+    """Fingerprint part-pair for one knob: ``[]`` at the default value
+    (pre-knob journals keep their digest), else ``[name, value]``.
+    Splat the result into the ``fingerprint(...)`` part list:
+
+        fingerprint(*base_parts,
+                    *fold_nondefault("input_enc", input_enc, "f32"))
+
+    The comparison is ``==``, so pass values already normalized to the
+    journaled representation (e.g. ``bool(guard)``, not a truthy
+    object — ``repr`` of the part is what lands in the digest)."""
+    return [] if value == default else [name, value]

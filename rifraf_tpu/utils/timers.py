@@ -4,10 +4,19 @@ The reference has no profiling at all (SURVEY §5); the TPU driver needs
 it because its cost structure is invisible from Python — a slow run can
 be retracing, dispatch overhead, device compute, or host tracebacks, and
 only per-section timing tells them apart.
+
+Thread-safe: the serving stack shares one ``Timers`` (via
+``serve.stats.ServerStats``) across worker, batcher, and supervisor
+threads, so the read-modify-write in ``add`` and the iterations in
+``merge``/``summary``/``to_dict`` run under an internal lock — an
+unsynchronized ``data[name] = (n + 1, s + seconds)`` loses increments
+when two sections finish concurrently, and iterating while another
+thread inserts raises RuntimeError.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Tuple
@@ -17,11 +26,13 @@ class Timers:
     """name -> (calls, total_seconds); zero-dependency, host wall clock."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.data: Dict[str, Tuple[int, float]] = {}
 
     def add(self, name: str, seconds: float) -> None:
-        n, s = self.data.get(name, (0, 0.0))
-        self.data[name] = (n + 1, s + seconds)
+        with self._lock:
+            n, s = self.data.get(name, (0, 0.0))
+            self.data[name] = (n + 1, s + seconds)
 
     @contextmanager
     def time(self, name: str):
@@ -32,15 +43,18 @@ class Timers:
             self.add(name, time.perf_counter() - t0)
 
     def merge(self, other: "Timers") -> None:
-        for name, (n, s) in other.data.items():
-            cn, cs = self.data.get(name, (0, 0.0))
-            self.data[name] = (cn + n, cs + s)
+        with other._lock:
+            items = list(other.data.items())
+        with self._lock:
+            for name, (n, s) in items:
+                cn, cs = self.data.get(name, (0, 0.0))
+                self.data[name] = (cn + n, cs + s)
 
     def summary(self) -> str:
+        with self._lock:
+            items = list(self.data.items())
         lines = []
-        for name, (n, s) in sorted(
-            self.data.items(), key=lambda kv: -kv[1][1]
-        ):
+        for name, (n, s) in sorted(items, key=lambda kv: -kv[1][1]):
             lines.append(f"  {name:28s} {n:6d} calls  {s*1e3:10.1f} ms")
         return "\n".join(lines)
 
@@ -49,9 +63,9 @@ class Timers:
         by descending total time like summary(). The serving stats
         surface (serve.stats.ServerStats) and bench.py emit this instead
         of reaching into .data."""
+        with self._lock:
+            items = list(self.data.items())
         return {
             name: {"calls": n, "seconds": round(s, 6)}
-            for name, (n, s) in sorted(
-                self.data.items(), key=lambda kv: -kv[1][1]
-            )
+            for name, (n, s) in sorted(items, key=lambda kv: -kv[1][1])
         }

@@ -117,7 +117,7 @@ def build_e2e_problem(tlen=TLEN, n_reads=N_READS, seed=0, error_rate=0.01):
 
 def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
             device_loop=None, do_score=False, band_dtype=None,
-            input_enc=None):
+            input_enc=None, speculate_k=None):
     """One full consensus; returns (wall_seconds, result)."""
     from rifraf_tpu.engine.driver import rifraf
     from rifraf_tpu.engine.params import RifrafParams
@@ -154,6 +154,8 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
         kw["band_dtype"] = band_dtype
     if input_enc is not None:
         kw["input_enc"] = input_enc
+    if speculate_k is not None:
+        kw["speculate_k"] = speculate_k
     params = RifrafParams(max_iters=max_iters, **kw)
     t0 = time.perf_counter()
     result = rifraf(seqs, phreds=phreds, params=params)
@@ -163,7 +165,7 @@ def run_e2e(seqs, phreds, bandwidth=None, max_iters=100, ref_default=False,
 def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
                 max_iters=100, verbose=False, ref_default=False,
                 device_loop=None, do_score=False, band_dtype=None,
-                input_enc=None):
+                input_enc=None, speculate_k=None):
     template, seqs, phreds = build_e2e_problem(tlen, n_reads)
     walls = []
     result = None
@@ -171,7 +173,8 @@ def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
         wall, result = run_e2e(seqs, phreds, bandwidth=bandwidth,
                                max_iters=max_iters, ref_default=ref_default,
                                device_loop=device_loop, do_score=do_score,
-                               band_dtype=band_dtype, input_enc=input_enc)
+                               band_dtype=band_dtype, input_enc=input_enc,
+                               speculate_k=speculate_k)
         if verbose:
             label = "compile+run" if i == 0 else "warm"
             print(f"  run {i}: {wall:.2f}s ({label})", file=sys.stderr)
@@ -180,6 +183,41 @@ def measure_e2e(tlen=TLEN, n_reads=N_READS, bandwidth=None, n_timed=N_TIMED,
     n_iters = int(result.state.stage_iterations.sum())
     recovered = bool(np.array_equal(result.consensus, template))
     return walls, n_iters, recovered, result
+
+
+def speculation_block(tlen=TLEN, n_reads=N_READS, n_timed=1,
+                      verbose=False, ref_default=False,
+                      device_loop="on", speculate_k=2):
+    """Serial vs speculative refine rounds on the same problem: runs
+    the config with speculate_k=0 and with ``speculate_k``, asserts the
+    consensus is identical (speculation is result-invariant by
+    construction — a hit replays the exact serial choice, a miss falls
+    back), and reports the round counts, hit rate, and wall seconds of
+    both legs. device_loop="on" because speculation lives in the
+    device-resident stage loop (engine.device_loop)."""
+    walls0, it0, _, res0 = measure_e2e(
+        tlen=tlen, n_reads=n_reads, n_timed=n_timed, verbose=verbose,
+        ref_default=ref_default, device_loop=device_loop, speculate_k=0)
+    walls_s, it_s, _, res_s = measure_e2e(
+        tlen=tlen, n_reads=n_reads, n_timed=n_timed, verbose=verbose,
+        ref_default=ref_default, device_loop=device_loop,
+        speculate_k=speculate_k)
+    m = res_s.metadata.get("speculation") or {}
+    rounds = sum(s["rounds"] for s in m.get("stages", {}).values())
+    rounds = rounds or it_s
+    return {
+        "speculate_k": speculate_k,
+        "serial_iterations": it0,
+        "speculative_rounds": rounds,
+        "round_reduction": round(it0 / max(rounds, 1), 2),
+        "attempts": m.get("attempts", 0),
+        "hits": m.get("hits", 0),
+        "hit_rate": m.get("hit_rate"),
+        "serial_s": round(min(walls0), 3),
+        "speculative_s": round(min(walls_s), 3),
+        "consensus_identical": bool(
+            np.array_equal(res0.consensus, res_s.consensus)),
+    }
 
 
 # the device round-trip sections of Timers.data: every host-loop
@@ -360,6 +398,13 @@ def _northstar_mode():
             "seconds_per_iteration": round(wall / max(n_iters, 1), 4),
             "template_recovered": recovered,
             "roofline": roofline_stats(res),
+            # the banded 10 kb config read-chunks the speculative
+            # launch's duplicated reads, so only the 2048x1kb leg
+            # measures speculation
+            "speculation": (speculation_block(tlen=tlen,
+                                              n_reads=n_reads,
+                                              n_timed=1)
+                            if label == "2048x1kb" else None),
         }))
 
 
@@ -1443,6 +1488,8 @@ def main():
             },
             "host_loop": dict(host_dispatch_stats(res_h, walls_h),
                               e2e_seconds=round(min(walls_h), 3)),
+            "speculation": speculation_block(
+                n_timed=1, ref_default=True, device_loop="on"),
         }))
         return 0
 
@@ -1485,6 +1532,8 @@ def main():
             "iterations": it_ns,
             "template_recovered": rec_ns,
             "roofline": roofline_stats(res_ns),
+            "speculation": speculation_block(tlen=1000, n_reads=2048,
+                                             n_timed=1),
         }
         # do_score=True at the north-star shape: the quality-estimation
         # tail (SCORE-stage realign with the on-core stats kernel + move

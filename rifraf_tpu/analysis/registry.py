@@ -27,6 +27,7 @@ PROGRAM_IDENTITY_KNOBS = (
     "impl",         # fused Pallas implementation: "mega" | "split"
     "want_edge",    # edge-hit statistics output (adaptive band growth)
     "want_guard",   # integrity guard-word output (PR 11)
+    "speculate_k",  # speculative edit-set segments per launch (PR 15)
 )
 
 # Parameter names that satisfy a knob (a factory may spell the edge
@@ -38,6 +39,7 @@ KNOB_ALIASES = {
     "impl": ("impl",),
     "want_edge": ("want_edge", "use_edits"),
     "want_guard": ("want_guard",),
+    "speculate_k": ("speculate_k",),
 }
 
 # Files scanned for lru_cache'd factories. EVERY lru_cache'd function
@@ -57,20 +59,27 @@ _XLA_EXEMPT = {
     "input_enc": "the XLA path consumes exact f32 inputs; BatchAligner "
                  "routes packed encodings to the Pallas runners only",
 }
+_NO_SPEC_FRAME = {
+    "speculate_k": "frame realignment runs a fixed codon sweep, not "
+                   "the refine hill-climb; there is no next round to "
+                   "speculate",
+}
 PROGRAM_FACTORIES = {
     ("rifraf_tpu/engine/realign.py", "_pallas_frame_runner"): {
         "required": ("band_dtype", "input_enc", "impl"),
-        "exempt": {
-            "want_edge": "frame realignment computes no traceback "
-                         "statistics; edge hits are sweep-stage outputs",
-            "want_guard": "guard words are sweep/serve integrity "
-                          "outputs; the frame loop never packs them",
-        },
+        "exempt": dict(
+            _NO_SPEC_FRAME,
+            want_edge="frame realignment computes no traceback "
+                      "statistics; edge hits are sweep-stage outputs",
+            want_guard="guard words are sweep/serve integrity "
+                       "outputs; the frame loop never packs them",
+        ),
     },
     ("rifraf_tpu/engine/realign.py", "_xla_frame_runner"): {
         "required": ("band_dtype",),
         "exempt": dict(
             _XLA_EXEMPT,
+            **_NO_SPEC_FRAME,
             want_edge="frame realignment computes no traceback "
                       "statistics; edge hits are sweep-stage outputs",
             want_guard="guard words are sweep/serve integrity outputs; "
@@ -82,10 +91,14 @@ PROGRAM_FACTORIES = {
         "exempt": {
             "want_guard": "the realign driver verifies guards in its "
                           "own adapt rounds, never in the stage loop",
+            "speculate_k": "speculative rounds need the XLA segmented "
+                           "step (the megakernel fills one template "
+                           "per launch); stage_runner routes a "
+                           "speculating stage to _xla_stage_runner",
         },
     },
     ("rifraf_tpu/engine/realign.py", "_xla_stage_runner"): {
-        "required": ("band_dtype", "want_edge"),
+        "required": ("band_dtype", "want_edge", "speculate_k"),
         "exempt": dict(
             _XLA_EXEMPT,
             want_guard="the realign driver verifies guards in its own "
@@ -100,10 +113,14 @@ PROGRAM_FACTORIES = {
                     "(RIFRAF_TPU_FUSED_IMPL read at trace time); the "
                     "inner realign factories carry it where both impls "
                     "can coexist",
+            "speculate_k": "adapt rounds are single scoring launches "
+                           "over a fixed template, not the refine "
+                           "hill-climb; nothing to speculate",
         },
     },
     ("rifraf_tpu/parallel/sweep_sharded.py", "_stage_program"): {
-        "required": ("band_dtype", "input_enc", "want_edge"),
+        "required": ("band_dtype", "input_enc", "want_edge",
+                     "speculate_k"),
         "exempt": {
             "impl": "the fused impl is process-global "
                     "(RIFRAF_TPU_FUSED_IMPL read at trace time); the "
@@ -122,6 +139,9 @@ PROGRAM_FACTORIES = {
                     "(RIFRAF_TPU_FUSED_IMPL read at trace time); the "
                     "inner realign factories carry it where both impls "
                     "can coexist",
+            "speculate_k": "adapt rounds are single scoring launches "
+                           "over a fixed template, not the refine "
+                           "hill-climb; nothing to speculate",
         },
     },
     ("rifraf_tpu/parallel/sweep_sharded.py", "_seg_stage_program"): {
@@ -134,6 +154,10 @@ PROGRAM_FACTORIES = {
             "want_guard": "guard flags are produced by the adapt-round "
                           "programs only; the INIT stage never packs "
                           "them",
+            "speculate_k": "the segment-packed stage program already "
+                           "spends the segment axis on cluster "
+                           "packing; ChunkExecutor speculates only "
+                           "through the unsegmented _stage_program",
         },
     },
 }
@@ -156,6 +180,10 @@ FINGERPRINT_KNOBS = (
     "proposals",
     "scores",
     "content",
+    # speculation is result-identical, but its journal records
+    # different round-level provenance (attempt/hit stats), so a
+    # resume must not silently mix the two modes (PR 15)
+    "speculate_k",
 )
 
 # Identifiers (parameter names, attribute names, or string-literal part
@@ -174,13 +202,15 @@ FINGERPRINT_ALIASES = {
     # a content signal: the sweep digests every cluster's reads, the
     # spool digests the file head
     "content": ("_content_digest", "sha256", "head"),
+    "speculate_k": ("speculate_k",),
 }
 
 FINGERPRINT_BUILDERS = {
     ("rifraf_tpu/parallel/sweep_sharded.py", "_journal_fingerprint"): {
         "required": ("band_dtype", "band_growth", "input_enc", "guard",
                      "verify_fraction", "max_iters", "min_dist",
-                     "bandwidth_pvalue", "proposals", "content"),
+                     "bandwidth_pvalue", "proposals", "content",
+                     "speculate_k"),
         "exempt": {
             "scores": "per-read score parameters are hashed inside "
                       "_content_digest's per-read tuples",
@@ -189,7 +219,7 @@ FINGERPRINT_BUILDERS = {
     ("rifraf_tpu/cli/serve.py", "_spool_fingerprint"): {
         "required": ("band_dtype", "band_growth", "input_enc", "guard",
                      "verify_fraction", "max_iters", "proposals",
-                     "scores", "content"),
+                     "scores", "content", "speculate_k"),
         "exempt": {
             "min_dist": "the serve CLI exposes no flag; every spool "
                         "run uses the pinned ServeConfig default",
@@ -275,6 +305,7 @@ ENV_GATES = {
     "RIFRAF_TPU_HBM_BUDGET": "docs/analysis.md",
     "RIFRAF_TPU_DEBUG": "docs/analysis.md",
     "RIFRAF_TPU_BAND_DTYPE": "docs/analysis.md",
+    "RIFRAF_TPU_SPEC_DEBUG": "docs/api.md",
 }
 # the analysis package itself is excluded: its registry and fixtures
 # NAME the gates without reading them

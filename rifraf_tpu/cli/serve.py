@@ -129,6 +129,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "programs themselves stay exact — the value "
                         "keys program caches and the resume "
                         "fingerprint, see docs/api.md Input encoding)")
+    p.add_argument("--speculate-k", type=int, default=0,
+                   choices=(0, 1, 2),
+                   help="speculative edit-set evaluation: score this "
+                        "many next-round composites alongside every "
+                        "refine round in one segmented launch and skip "
+                        "a round on a verified hit (results stay "
+                        "bit-identical; 0 = serial hill-climb, see "
+                        "docs/api.md Speculative refinement)")
     p.add_argument("--alignment-proposals", action="store_true",
                    help="use the full single-indel proposal pass instead "
                         "of the seeded edits gate")
@@ -216,6 +224,7 @@ def config_from_args(args) -> ServeConfig:
         band_dtype=args.band_dtype,
         band_growth=args.band_growth,
         input_enc=args.input_enc,
+        speculate_k=args.speculate_k,
         guard=args.guard,
         verify_fraction=args.verify_fraction,
         quarantine_threshold=args.quarantine_threshold,
@@ -519,6 +528,7 @@ def _spool_fingerprint(path: str, args, config: ServeConfig) -> str:
         *fold_nondefault("guard", bool(config.guard), False),
         *fold_nondefault("verify_fraction", config.verify_fraction,
                          0.0),
+        *fold_nondefault("speculate_k", config.speculate_k, 0),
     )
 
 

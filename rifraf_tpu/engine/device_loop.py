@@ -43,6 +43,7 @@ too far from its entry length for the compiled band margins.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, NamedTuple
 
 import jax
@@ -52,6 +53,10 @@ import numpy as np
 CAP = 192  # top-k candidate cap; overflow falls back to the host loop
 MAX_DRIFT = 48  # max template-length drift inside one compiled loop
 NEG = jnp.float32(np.finfo(np.float32).min / 2)
+# trace-time flag: per-round speculation diagnostics (prediction size,
+# next-round actual, match/rollback bits) via jax.debug.print. Purely
+# a debugging aid — adds no ops when unset
+_SPEC_DEBUG = os.environ.get("RIFRAF_TPU_SPEC_DEBUG", "") == "1"
 
 
 class StageResult(NamedTuple):
@@ -65,6 +70,12 @@ class StageResult(NamedTuple):
     # host must see the same old_score that iteration saw, not the
     # current score — else its stall check compares the score to itself)
     old_score: float = -np.inf
+    # speculative evaluation accounting (speculate_k > 0 runners only):
+    # launches that packed speculative segments, and how many verified —
+    # each hit consumed TWO counted iterations in one launch, so the
+    # stage took n_iters - spec_hits scoring rounds instead of n_iters
+    spec_attempts: int = 0
+    spec_hits: int = 0
 
 
 def _candidate_scores(sub_t, ins_t, del_t, tmpl, tlen, total, do_indels,
@@ -133,10 +144,12 @@ def _decode(idx):
     return kind, pos, base, anchor
 
 
-def _choose(cand_flat, min_dist: int):
+def _choose_parts(cand_flat, min_dist: int):
     """top-k + greedy min-dist filter (choose_candidates,
-    proposals.jl:104-115). Returns (kind, pos, base, keep, n_improving,
-    best_score)."""
+    proposals.jl:104-115), exposing the intermediate arrays so the
+    speculative composer can continue the greedy walk past the kept
+    set. Returns (vals, ok, kind, pos, base, anchor, keep,
+    n_improving)."""
     vals, idxs = jax.lax.top_k(cand_flat, CAP)
     ok = vals > NEG
     n_improving = jnp.sum((cand_flat > NEG).astype(jnp.int32))
@@ -154,7 +167,106 @@ def _choose(cand_flat, min_dist: int):
         0, CAP, body, jnp.full((CAP,), -(10**9), jnp.int32)
     )
     keep = kept_anchor >= 0
+    return vals, ok, kind, pos, base, anchor, keep, n_improving
+
+
+def _choose(cand_flat, min_dist: int):
+    """top-k + greedy min-dist filter. Returns (kind, pos, base, keep,
+    n_improving, best_score)."""
+    (vals, ok, kind, pos, base, anchor, keep,
+     n_improving) = _choose_parts(cand_flat, min_dist)
     return kind, pos, base, keep, n_improving, vals[0]
+
+
+# layer-1 blocking radius for the speculative composite. Empirically,
+# a blocked candidate within a few bases of an applied edit is almost
+# always an alternative fix of the SAME underlying error — it stops
+# improving once the neighbour lands, so admitting it poisons the
+# predicted set. Candidates farther out are usually independent errors
+# that the next serial round really does pick. Must stay >= 2, the
+# floor that keeps the coordinate remap in _remap_pos exact (no
+# layer-1 edit touches a layer-2 position or shares its insertion
+# anchor).
+SPEC_NEAR_RADIUS = 6
+
+
+def _choose_next_set(ok, anchor, keep, min_dist: int,
+                     near_radius: int = SPEC_NEAR_RADIUS):
+    """The speculative composite: continue _choose's greedy min-dist
+    walk over the SAME top-CAP candidate list, excluding the layer-1
+    picks. The next serial round enforces min_dist only among ITS OWN
+    picks — the candidates it is most likely to accept are exactly the
+    ones round k blocked — so layer-1 anchors block at ``near_radius``
+    only (near ones are likely alternative fixes of an already-fixed
+    error), while layer-2 picks block each other at the full min_dist
+    like any real round. Whether the next round actually accepts this
+    set is verified against the winner's own dense tables.
+    ``near_radius`` must stay >= 2 (the _remap_pos exactness floor);
+    the single-best segment passes 2 to keep genuine near-neighbour
+    survivors reachable."""
+    assert near_radius >= 2
+    blocked = jnp.where(keep, anchor, -(10**9))
+
+    def body(c, kept2):
+        a = anchor[c]
+        clash = jnp.any(
+            (jnp.abs(a - blocked) < near_radius) & (blocked >= 0)
+        ) | jnp.any((jnp.abs(a - kept2) < min_dist) & (kept2 >= 0))
+        keep_c = ok[c] & jnp.logical_not(keep[c]) & jnp.logical_not(clash)
+        return kept2.at[c].set(jnp.where(keep_c, a, -(10**9)))
+
+    kept2 = jax.lax.fori_loop(
+        0, CAP, body, jnp.full((CAP,), -(10**9), jnp.int32)
+    )
+    return kept2 >= 0
+
+
+def _indel_shifts(tlen, kind, pos, keep, Tmax: int):
+    """_apply's insertion/deletion cumulants for a kept edit set WITHOUT
+    applying it: (inc_ins [Tmax+1], exc_del [Tmax+1]) — the coordinate
+    shift every surviving position experiences after the set lands."""
+    is_del = keep & (kind == 1)
+    is_ins = keep & (kind == 2)
+    del_mark = jnp.zeros((Tmax,), bool).at[pos].max(is_del, mode="drop")
+    ins_mark = jnp.zeros((Tmax + 1,), bool).at[
+        jnp.where(is_ins, pos, Tmax + 1)
+    ].max(is_ins, mode="drop")
+    del_mark = del_mark & (jnp.arange(Tmax) < tlen)
+    ins_mark = ins_mark & (jnp.arange(Tmax + 1) <= tlen)
+    inc_ins = jnp.cumsum(ins_mark.astype(jnp.int32))
+    exc_del = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(del_mark.astype(jnp.int32))]
+    )
+    return inc_ins, exc_del
+
+
+def _remap_pos(pos, inc_ins, exc_del):
+    """Map an edit position from pre-apply to post-apply coordinates:
+    a surviving base at j lands at j + inc_ins[j] - exc_del[j]. The
+    radius-2 anchor separation between the layer-1 and layer-2 sets
+    (_choose_next_set) guarantees no layer-1 edit touches a layer-2
+    position (so the base survives), and no layer-1 insertion shares a
+    layer-2 insertion anchor (so the same formula covers insertions:
+    inc == exc there). Layer-2 edits may shift CLOSER to each other
+    (indels between them); _spec_sep_ok rejects a composite whose
+    remapped anchors fall under the _apply separation floor."""
+    return pos + inc_ins[pos] - exc_del[pos]
+
+
+def _spec_sep_ok(kind, pos_r, keep2, Tmax: int):
+    """True when the remapped layer-2 anchors still satisfy _apply's
+    independence floor (pairwise >= 2). Layer-1 indels can contract
+    layer-2 gaps by one per indel in the gap — at min_dist >= 4 the
+    floor can never be crossed, but the check is cheap and keeps tiny
+    min_dist configurations safe (an invalid composite is clamped to a
+    duplicate segment and can never match)."""
+    a_r = jnp.where(kind == 2, pos_r, pos_r + 1)
+    # unique far-apart fillers for the dropped lanes so they can never
+    # trip the adjacent-difference test
+    fill = 4 * Tmax + 2 * jnp.arange(CAP, dtype=a_r.dtype)
+    srt = jnp.sort(jnp.where(keep2, a_r, fill))
+    return jnp.all(srt[1:] - srt[:-1] >= 2)
 
 
 def _apply(tmpl, tlen, kind, pos, base, keep, Tmax: int):
@@ -209,13 +321,16 @@ def _isclose(a, b):
     return jnp.abs(a - b) <= 1e-8 + 1e-5 * jnp.abs(b)
 
 
-def unpack_stage_packed(packed, H: int, Tmax: int):
+def unpack_stage_packed(packed, H: int, Tmax: int, speculate: bool = False):
     """Host-side view of ONE packed stage-program row (the single-fetch
     array built at the end of ``run`` below): returns ``(tlen, total,
     n_rec, completed, resume_old, hlen [H] int64, hist [H, Tmax] int8,
     tmpl [Tmax] int8)``. The one consumer-side copy of the layout,
     shared by ``runner`` and parallel.sweep_sharded's per-bucket
-    unpack."""
+    unpack. ``speculate=True`` rows (speculate_k > 0 runners) carry a
+    two-scalar ``[spec_attempts, spec_hits]`` tail appended AFTER the
+    default layout — the front offsets are byte-identical either way —
+    and the tuple gains those two ints."""
     p = np.asarray(packed)
     o = 5
     hlen = p[o : o + H].astype(np.int64)
@@ -223,8 +338,12 @@ def unpack_stage_packed(packed, H: int, Tmax: int):
     hist = p[o : o + H * Tmax].reshape(H, Tmax).astype(np.int8)
     o += H * Tmax
     tmpl = p[o : o + Tmax].astype(np.int8)
-    return (int(p[0]), float(p[1]), int(p[2]), bool(p[3]), float(p[4]),
-            hlen, hist, tmpl)
+    out = (int(p[0]), float(p[1]), int(p[2]), bool(p[3]), float(p[4]),
+           hlen, hist, tmpl)
+    if speculate:
+        o += Tmax
+        out = out + (int(p[o]), int(p[o + 1]))
+    return out
 
 
 def make_stage_runner(
@@ -239,6 +358,8 @@ def make_stage_runner(
     plan=None,
     seg_step_fn: Callable = None,
     aot_key=None,
+    speculate_k: int = 0,
+    spec_step_fn: Callable = None,
 ):
     """Build the jitted whole-stage runner. ``step_fn`` takes the
     device-resident batch state as an ARGUMENT pytree (not a closure) so
@@ -270,7 +391,33 @@ def make_stage_runner(
     Values are unchanged: the per-segment reductions reproduce
     ``step_fn``'s sums exactly (ops.fused.fused_step_segmented), and
     the same rollback comparison selects the same winner — the
-    conditional path merely skipped computing the loser."""
+    conditional path merely skipped computing the loser.
+
+    ``speculate_k`` (0, 1, or 2) enables SPECULATIVE edit-set
+    evaluation: every scoring round packs, alongside the round's
+    {multi-applied, single-best} pair, up to ``speculate_k`` candidate
+    templates for the NEXT round — the greedy min-dist walk continued
+    past this round's picks (the composite edit set round k+1 is
+    expected to accept), applied on the predicted winner — as extra
+    segments of the SAME launch via ``spec_step_fn`` ``(tmpls [S,Tmax],
+    tlens [S], step_state) -> tables`` with a leading segment axis,
+    S = 2 + speculate_k. After the launch, round k+1's greedy rule is
+    replayed against the winner's OWN dense tables (they came back in
+    segment 0/1); when the replay lands exactly on a speculative
+    template, its tables are already in hand and the loop advances TWO
+    counted iterations for one launch — an entire round, realign
+    included, is skipped. On a miss the carry is bit-identical to the
+    serial round's exit, and the next body iteration recomputes round
+    k+1 from the same tables — zero result change, only the speculative
+    lanes were wasted. ``speculate_k=0`` (default) leaves the legacy
+    body untouched — bit-identical program, byte-identical packed
+    layout. When speculating, ``spec_step_fn`` supersedes
+    ``seg_step_fn`` (the rollback pair rides the same launch)."""
+    if speculate_k not in (0, 1, 2):
+        raise ValueError(f"speculate_k must be 0, 1, or 2: {speculate_k}")
+    if speculate_k and spec_step_fn is None:
+        raise ValueError("speculate_k > 0 requires spec_step_fn")
+    speculating = speculate_k > 0
 
     def cond(carry):
         return jnp.logical_not(carry["done"]) & (
@@ -389,6 +536,260 @@ def make_stage_runner(
             "step_state": carry["step_state"],
         }
 
+    def body_spec(carry):
+        # the speculative round: identical pre-launch logic to ``body``
+        # (same candidate scoring, same greedy choose, same bail/stall
+        # exits), then ONE S-segment launch scoring {multi, single-best,
+        # speculative composite(s)} together, then the serial rollback
+        # rule for round k and a replay of round k+1's greedy rule
+        # against the winner's freshly-fetched tables
+        tmpl, tlen = carry["tmpl"], carry["tlen"]
+        total, sub_t, ins_t, del_t = carry["tables"][:4]
+        gates = carry["tables"][4] if gate != "none" else None
+        it = carry["it"]
+        hist = jax.lax.dynamic_update_slice(
+            carry["hist"], tmpl[None], (it, jnp.zeros_like(it))
+        )
+        hlen = carry["hlen"].at[it].set(tlen)
+
+        if stop_on_same:
+            stop_same = ((it + carry["prev_iters"]) > 0) & (
+                total == carry["old_score"]
+            )
+        else:
+            stop_same = jnp.asarray(False)
+
+        cand = _candidate_scores(
+            sub_t, ins_t, del_t, tmpl, tlen, total, do_indels, Tmax,
+            do_subs, gate, gates,
+        )
+        (vals, ok, kind, pos, base, anchor, keep,
+         n_improving) = _choose_parts(cand, min_dist)
+        best = vals[0]
+        no_cand = n_improving == 0
+        overflow = n_improving > CAP
+
+        tmpl_multi, tlen_multi = _apply(tmpl, tlen, kind, pos, base, keep,
+                                        Tmax)
+        n_keep = jnp.sum(keep.astype(jnp.int32))
+        drift = (tlen_multi + 1 >= Tmax) | (
+            jnp.abs(tlen_multi - carry["tlen0"]) > MAX_DRIFT
+        )
+        bail = (overflow | drift) & jnp.logical_not(stop_same | no_cand)
+        done = stop_same | no_cand | bail
+        do_work = jnp.logical_not(done)
+
+        def guard_spec(sp, sl, fallback_t, fallback_l, extra_ok):
+            # a speculative template must respect the compiled margins
+            # (band height / padded buffer) like any real round; out of
+            # range (or structurally invalid per extra_ok), substitute
+            # a harmless duplicate and never match
+            sp_ok = extra_ok & (sl + 1 < Tmax) & (
+                jnp.abs(sl - carry["tlen0"]) <= MAX_DRIFT
+            )
+            return (sp_ok, jnp.where(sp_ok, sp, fallback_t),
+                    jnp.where(sp_ok, sl, fallback_l))
+
+        def work(_):
+            keep1 = keep & (jnp.cumsum(keep.astype(jnp.int32)) == 1)
+            tmpl1, tlen1 = _apply(tmpl, tlen, kind, pos, base, keep1, Tmax)
+
+            # compose the speculative edit set(s): the greedy walk
+            # continued past the layer-1 picks, positions remapped
+            # through layer-1's indels, applied on the predicted winner
+            keep2 = _choose_next_set(ok, anchor, keep, min_dist)
+            inc_ins, exc_del = _indel_shifts(tlen, kind, pos, keep, Tmax)
+            pos_r = _remap_pos(pos, inc_ins, exc_del)
+            sep_ok = _spec_sep_ok(kind, pos_r, keep2, Tmax)
+            spec0, sl0 = _apply(tmpl_multi, tlen_multi, kind, pos_r, base,
+                                keep2, Tmax)
+            spec0_ok, spec0, sl0 = guard_spec(spec0, sl0, tmpl_multi,
+                                              tlen_multi, sep_ok)
+            tmpls = [tmpl_multi, tmpl1, spec0]
+            tlens = [tlen_multi, tlen1, sl0]
+            if speculate_k >= 2:
+                # the single-best segment drops the composite's poison
+                # filter to the radius-2 floor: a genuine straggler 2-5
+                # bases from a layer-1 edit is exactly the shape of the
+                # common one-edit endgame round, and a single edit can't
+                # be poisoned by extra picks
+                keep2n = _choose_next_set(ok, anchor, keep, min_dist,
+                                          near_radius=2)
+                keep2_1 = keep2n & (
+                    jnp.cumsum(keep2n.astype(jnp.int32)) == 1
+                )
+                spec1, sl1 = _apply(tmpl_multi, tlen_multi, kind, pos_r,
+                                    base, keep2_1, Tmax)
+                # a single edit has no pairwise separation to violate
+                spec1_ok, spec1, sl1 = guard_spec(spec1, sl1, tmpl_multi,
+                                                  tlen_multi,
+                                                  jnp.asarray(True))
+                tmpls.append(spec1)
+                tlens.append(sl1)
+
+            outs = spec_step_fn(
+                jnp.stack(tmpls), jnp.stack(tlens), carry["step_state"]
+            )
+            # round-k resolve: the serial rollback rule, segment 0 vs 1
+            total_m = outs[0][0]
+            rollback = (n_keep > 1) & (
+                (total_m < best) | _isclose(total_m, best)
+            )
+            w_tmpl = jnp.where(rollback, tmpl1, tmpl_multi)
+            w_tlen = jnp.where(rollback, tlen1, tlen_multi)
+            tables_w = jax.tree_util.tree_map(
+                lambda x: jnp.where(rollback, x[1], x[0]), outs
+            )
+            total_w = tables_w[0]
+
+            # replay round k+1's greedy rule against the winner's OWN
+            # dense tables; the stall guard (it+1+prev_iters) > 0 always
+            # holds at iteration it+1
+            gates_w = tables_w[4] if gate != "none" else None
+            if stop_on_same:
+                stop_same2 = total_w == total
+            else:
+                stop_same2 = jnp.asarray(False)
+            cand2 = _candidate_scores(
+                tables_w[1], tables_w[2], tables_w[3], w_tmpl, w_tlen,
+                total_w, do_indels, Tmax, do_subs, gate, gates_w,
+            )
+            (vals2, ok2, kind2, pos2, base2, anchor2, keep2a,
+             n_improving2) = _choose_parts(cand2, min_dist)
+            best2 = vals2[0]
+            no_cand2 = n_improving2 == 0
+            overflow2 = n_improving2 > CAP
+            tmpl_m2, tlen_m2 = _apply(w_tmpl, w_tlen, kind2, pos2, base2,
+                                      keep2a, Tmax)
+            n_keep2 = jnp.sum(keep2a.astype(jnp.int32))
+            drift2 = (tlen_m2 + 1 >= Tmax) | (
+                jnp.abs(tlen_m2 - carry["tlen0"]) > MAX_DRIFT
+            )
+            done2 = stop_same2 | no_cand2 | overflow2 | drift2
+            can2 = (it + 1) < carry["iters_left"]
+
+            # a hit = the replayed choice IS a speculative template
+            # (bit-equal buffer), so its score/tables are already here
+            match0 = spec0_ok & (sl0 == tlen_m2) & jnp.all(spec0 == tmpl_m2)
+            total_m2 = outs[0][2]
+            rollback2 = (n_keep2 > 1) & (
+                (total_m2 < best2) | _isclose(total_m2, best2)
+            )
+            if speculate_k >= 2:
+                keep2b = keep2a & (
+                    jnp.cumsum(keep2a.astype(jnp.int32)) == 1
+                )
+                tmpl1_2, tlen1_2 = _apply(w_tmpl, w_tlen, kind2, pos2,
+                                          base2, keep2b, Tmax)
+                match1 = spec1_ok & (sl1 == tlen1_2) & jnp.all(
+                    spec1 == tmpl1_2
+                )
+                # when round k+1 applies exactly ONE edit, the full-set
+                # and single-best templates coincide and the serial
+                # rollback cannot fire (it needs n_keep2 > 1), so
+                # matching EITHER speculative segment suffices — the
+                # single-best segment often survives rounds where extra
+                # predicted edits spoiled the composite. rollback2 is
+                # only meaningful under match0 (its score input is
+                # segment 2's total), and single1 never consults it.
+                single1 = n_keep2 == 1
+                hit = (can2 & jnp.logical_not(done2)
+                       & jnp.logical_not(rollback)
+                       & ((match0
+                           & (jnp.logical_not(rollback2) | match1))
+                          | (single1 & match1)))
+                use1 = (match0 & rollback2) | (
+                    single1 & match1 & jnp.logical_not(match0)
+                )
+                tables_hit = jax.tree_util.tree_map(
+                    lambda x: jnp.where(use1, x[3], x[2]), outs
+                )
+                tmpl_hit = jnp.where(use1, tmpl1_2, tmpl_m2)
+                tlen_hit = jnp.where(use1, tlen1_2, tlen_m2)
+            else:
+                hit = (can2 & jnp.logical_not(done2)
+                       & jnp.logical_not(rollback)
+                       & jnp.logical_not(rollback2) & match0)
+                tables_hit = jax.tree_util.tree_map(lambda x: x[2], outs)
+                tmpl_hit = tmpl_m2
+                tlen_hit = tlen_m2
+
+            tmpl_n = jnp.where(hit, tmpl_hit, w_tmpl)
+            tlen_n = jnp.where(hit, tlen_hit, w_tlen)
+            tables_n = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(hit, a, b), tables_hit, tables_w
+            )
+            if _SPEC_DEBUG:
+                jax.debug.print(
+                    "spec it={it} keep1={nk} pred={np} next={nn} "
+                    "rb={rb} rb2={rb2} done2={d2} same2={ss} can2={c2} "
+                    "ok0={ok} dlen={dl} ndiff={nd} hit={h}",
+                    it=it, nk=n_keep, np=jnp.sum(keep2.astype(jnp.int32)),
+                    nn=n_keep2, rb=rollback, rb2=rollback2, d2=done2,
+                    ss=stop_same2, c2=can2, ok=spec0_ok,
+                    dl=sl0 - tlen_m2,
+                    nd=jnp.sum((spec0 != tmpl_m2).astype(jnp.int32)),
+                    h=hit,
+                )
+                big = jnp.int32(10**6)
+
+                def _first8(m, a):
+                    return jnp.sort(jnp.where(m, a, big))[:8]
+
+                jax.debug.print(
+                    "  l1={l1} predP={pa} predK={pk} nextP={na} "
+                    "nextK={nk2}",
+                    l1=_first8(keep, anchor),
+                    pa=_first8(keep2, pos_r),
+                    pk=_first8(keep2, kind * 10000 + pos_r),
+                    na=_first8(keep2a, pos2),
+                    nk2=_first8(keep2a, kind2 * 10000 + pos2),
+                )
+                if speculate_k >= 2:
+                    jax.debug.print(
+                        "  specN={sn} spec1K={s1}",
+                        sn=_first8(keep2n, kind * 10000 + pos_r),
+                        s1=_first8(keep2_1, kind * 10000 + pos_r),
+                    )
+            return tmpl_n, tlen_n, tables_n, hit, w_tmpl, w_tlen, total_w
+
+        def no_work(_):
+            return (tmpl, tlen, carry["tables"], jnp.asarray(False),
+                    tmpl, tlen, total)
+
+        (tmpl_n, tlen_n, tables_n, hit, w_tmpl, w_tlen,
+         w_total) = jax.lax.cond(do_work, work, no_work, None)
+        # a hit consumed round k+1 too: record ITS iteration top (the
+        # round-k winner) exactly as the serial loop would have
+        hist2 = jax.lax.dynamic_update_slice(
+            hist, w_tmpl[None], (it + 1, jnp.zeros_like(it))
+        )
+        hist = jnp.where(hit, hist2, hist)
+        hlen = jnp.where(hit, hlen.at[it + 1].set(w_tlen), hlen)
+        adv = jnp.where(done, 0, jnp.where(hit, 2, 1))
+        return {
+            "tmpl": tmpl_n,
+            "tlen": tlen_n,
+            "tables": tables_n,
+            # on a hit the carry mirrors the serial state AFTER round
+            # k+1: old_score = the winner's total (round k+1's top),
+            # old_score_prev = round k's top total
+            "old_score": jnp.where(hit, w_total, total),
+            "done": done,
+            "bail": carry["bail"] | bail,
+            "it": it + adv,
+            "n_rec": jnp.where(bail, it, it + jnp.maximum(adv, 1)),
+            "old_score_prev": jnp.where(hit, total, carry["old_score"]),
+            "hist": hist,
+            "hlen": hlen,
+            "tlen0": carry["tlen0"],
+            "iters_left": carry["iters_left"],
+            "prev_iters": carry["prev_iters"],
+            "step_state": carry["step_state"],
+            "spec_try": carry["spec_try"] + do_work.astype(jnp.int32),
+            "spec_hit": carry["spec_hit"] + hit.astype(jnp.int32),
+        }
+
     @jax.jit
     def run(tmpl0, tlen0, prev_score, iters_left, prev_iters, step_state):
         tables0 = step_fn(tmpl0, tlen0, step_state)
@@ -411,11 +812,16 @@ def make_stage_runner(
             "step_state": step_state,
             "old_score_prev": prev_score.astype(tables0[0].dtype),
         }
-        out = jax.lax.while_loop(cond, body, carry)
+        if speculating:
+            carry["spec_try"] = jnp.int32(0)
+            carry["spec_hit"] = jnp.int32(0)
+        out = jax.lax.while_loop(
+            cond, body_spec if speculating else body, carry
+        )
         # ONE packed fetch: scalars, per-iteration lengths, history,
         # template — in the step dtype so the final score survives intact
         pdt = out["tables"][0].dtype
-        packed = jnp.concatenate([
+        parts = [
             jnp.stack([
                 out["tlen"].astype(pdt),
                 out["tables"][0],
@@ -435,8 +841,15 @@ def make_stage_runner(
             out["hlen"].astype(pdt),
             out["hist"].astype(pdt).reshape(-1),
             out["tmpl"].astype(pdt),
-        ])
-        return packed
+        ]
+        if speculating:
+            # speculation tail AFTER the default layout: front offsets
+            # stay byte-identical for every existing consumer
+            parts.append(jnp.stack([
+                out["spec_try"].astype(pdt),
+                out["spec_hit"].astype(pdt),
+            ]))
+        return jnp.concatenate(parts)
 
     def runner(consensus: np.ndarray, prev_score: float,
                iters_left: int, prev_iters: int = 0,
@@ -451,8 +864,14 @@ def make_stage_runner(
                 float(prev_score), jnp.int32(iters_left),
                 jnp.int32(prev_iters), step_state)
         )
-        (tlen, total, n_rec, completed, resume_old, hlen, hist,
-         tmpl) = unpack_stage_packed(packed, H, Tmax)
+        spec_attempts = spec_hits = 0
+        if speculating:
+            (tlen, total, n_rec, completed, resume_old, hlen, hist,
+             tmpl, spec_attempts, spec_hits) = unpack_stage_packed(
+                packed, H, Tmax, speculate=True)
+        else:
+            (tlen, total, n_rec, completed, resume_old, hlen, hist,
+             tmpl) = unpack_stage_packed(packed, H, Tmax)
         history = [hist[i, : hlen[i]].copy() for i in range(n_rec)]
         return StageResult(
             consensus=tmpl[:tlen],
@@ -461,6 +880,8 @@ def make_stage_runner(
             history=history,
             completed=completed,
             old_score=resume_old,
+            spec_attempts=spec_attempts,
+            spec_hits=spec_hits,
         )
 
     # the raw compiled whole-stage program: callers that batch a CLUSTER

@@ -85,6 +85,11 @@ class RifrafState:
     # declines, and stage name -> chosen execution path
     device_declines: set = field(default_factory=set)
     stage_paths: dict = field(default_factory=dict)
+    # per-stage round accounting for speculative evaluation: stage name
+    # -> {"iterations", "rounds", "attempts", "hits"}; rounds counts
+    # scoring rounds actually paid (a speculation hit consumes two
+    # iterations in one round), attempts/hits the speculative launches
+    spec_stats: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -639,12 +644,27 @@ def _try_device_stage(
             history_cap=params.max_iters + 1,
             stop_on_same=full_batch,
             use_edits=params.do_alignment_proposals,
+            speculate_k=params.speculate_k,
         )
     if runner is None:
         return decline(
             "no whole-stage step engine fits (panel-mode template or "
             "reference bandwidth unsettled)"
         )
+    if params.speculate_k and not getattr(runner, "speculate_k", 0):
+        # speculation requested but not engaged for this stage: surface
+        # the reason once, decline-style, without leaving the device loop
+        reason = (
+            "speculation unsupported for FRAME (reference-scored rounds)"
+            if state.stage == Stage.FRAME else
+            "speculation declined (XLA shapes need read chunking or "
+            "exceed the dense-block threshold)"
+        )
+        key = (state.stage, reason)
+        if key not in state.device_declines:
+            state.device_declines.add(key)
+            _log(params, 1,
+                 f"speculation declined for {state.stage.name}: {reason}")
     stage_idx = int(state.stage) - 1
     res = runner(
         state.consensus,
@@ -653,9 +673,22 @@ def _try_device_stage(
         prev_iters=int(state.stage_iterations[stage_idx]),
     )
     state.stage_paths[state.stage.name] = "device_loop"
+    st = state.spec_stats.setdefault(
+        state.stage.name,
+        {"iterations": 0, "rounds": 0, "attempts": 0, "hits": 0},
+    )
+    st["iterations"] += res.n_iters
+    # each verified hit served two counted iterations from one round
+    st["rounds"] += res.n_iters - res.spec_hits
+    st["attempts"] += res.spec_attempts
+    st["hits"] += res.spec_hits
+    spec_note = (
+        f", speculation {res.spec_hits}/{res.spec_attempts} hits"
+        if res.spec_attempts else ""
+    )
     _log(params, 1,
          f"device stage {state.stage.name}: {res.n_iters} iterations, "
-         f"score {res.score}")
+         f"score {res.score}{spec_note}")
     state.consensus = np.asarray(res.consensus, dtype=np.int8)
     state.score = res.score
     state.stage_iterations[stage_idx] += res.n_iters
@@ -667,6 +700,27 @@ def _try_device_stage(
     if res.completed:
         finish_stage(state, params)
     return res
+
+
+def _speculation_metadata(state: RifrafState, params: RifrafParams) -> dict:
+    """The RifrafResult.metadata["speculation"] block: per-stage
+    iteration/round counts plus speculative-launch attempts and the
+    verified hit-rate (each hit = one whole round, realign included,
+    skipped). Present for every run — with speculate_k=0 it still
+    reports the per-stage round counts, so serial and speculative runs
+    compare field for field."""
+    attempts = sum(st["attempts"] for st in state.spec_stats.values())
+    hits = sum(st["hits"] for st in state.spec_stats.values())
+    return {
+        "enabled": params.speculate_k > 0,
+        "k": params.speculate_k,
+        "stages": {
+            name: dict(st) for name, st in sorted(state.spec_stats.items())
+        },
+        "attempts": attempts,
+        "hits": hits,
+        "hit_rate": (hits / attempts) if attempts else 0.0,
+    }
 
 
 def normalize_log_differences(sub_scores, del_scores, ins_scores, state_score):
@@ -888,6 +942,13 @@ def rifraf(
         iteration = iterations_used
         state.stage_iterations[int(state.stage) - 1] += 1
         state.stage_paths.setdefault(state.stage.name, "host")
+        # host iterations are one scoring round each, never speculative
+        host_st = state.spec_stats.setdefault(
+            state.stage.name,
+            {"iterations": 0, "rounds": 0, "attempts": 0, "hits": 0},
+        )
+        host_st["iterations"] += 1
+        host_st["rounds"] += 1
         consensus_stages[int(state.stage) - 1].append(state.consensus.copy())
         _log(params, 1, f"iteration {iteration} : {state.stage.name} : {state.score}")
         # per-iteration consensus dump (model.jl:1164-1168)
@@ -950,6 +1011,7 @@ def rifraf(
                     key=lambda kv: (int(kv[0]), kv[1]),
                 )
             ],
+            "speculation": _speculation_metadata(state, params),
         },
     )
     if params.do_score:

@@ -141,6 +141,18 @@ class RifrafParams:
     # docs/api.md "Input encoding"). Pallas-only: the XLA fallback,
     # panel, and mesh paths keep exact f32 inputs either way.
     input_enc: str = "f32"
+    # speculative edit-set evaluation in the device stage loop
+    # (engine.device_loop): 0 (default) is the legacy serial hill-climb,
+    # bit-identical program and packed layout; 1 or 2 packs that many
+    # speculative next-round composites as extra segments of every
+    # scoring launch (ops.fused.fused_step_segmented) and skips a whole
+    # round — realign included — whenever the replayed greedy rule lands
+    # on one (verified against the winner's own dense tables, so the
+    # final consensus is ALWAYS identical to the serial path). Device
+    # loop / XLA-step only; Pallas-eligible stages route to the XLA
+    # segmented step when speculating (ops.fused_pallas
+    # .mega_segment_eligible declines multi-template blocks).
+    speculate_k: int = 0
 
 
 def resolve_dtype(dtype) -> np.dtype:
@@ -219,6 +231,10 @@ def check_params(scores: Scores, reference_len: int, params: RifrafParams) -> No
     if params.band_dtype not in ("f32", "bf16"):
         raise ValueError(
             f"band_dtype must be 'f32' or 'bf16', got {params.band_dtype!r}"
+        )
+    if params.speculate_k not in (0, 1, 2):
+        raise ValueError(
+            f"speculate_k must be 0, 1, or 2, got {params.speculate_k!r}"
         )
     from ..ops.encoding import check_input_enc
     from .bandgrowth import check_band_growth

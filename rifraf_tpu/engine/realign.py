@@ -667,7 +667,7 @@ class BatchAligner:
     # --- device-resident stage loop ---------------------------------------
     def stage_runner(self, tlen0: int, do_indels: bool, min_dist: int,
                      history_cap: int, stop_on_same: bool,
-                     use_edits: bool = False):
+                     use_edits: bool = False, speculate_k: int = 0):
         """Jitted whole-stage hill-climb runner (engine.device_loop) over
         this batch, or None when no step engine fits. The compiled
         while-loop is cached at module level by static shape config
@@ -681,7 +681,18 @@ class BatchAligner:
         the device-resident do_alignment_proposals path (model.jl:
         483-497). One divergence from the host path: the in-loop step
         cannot raise on a malformed band (n_errors < 0) the way
-        realign(want_stats=True) does."""
+        realign(want_stats=True) does.
+
+        ``speculate_k`` > 0 requests speculative next-round composites
+        packed into every scoring launch (device_loop's speculative
+        body). Speculative blocks run the XLA segmented step — the
+        megakernel fills one template per launch (ops.fused_pallas
+        .mega_segment_eligible) — so a Pallas-eligible stage is routed
+        to the XLA runner while speculating; when the XLA shapes force
+        read chunking (chunked partial sums associate differently) or
+        exceed the dense-block threshold, speculation is dropped
+        instead (the serial path, ``runner.speculate_k == 0``). The
+        effective value is exposed as ``runner.speculate_k``."""
         import jax.numpy as jnp
 
         from .device_loop import MAX_DRIFT
@@ -696,6 +707,22 @@ class BatchAligner:
             # the program up -- the host loop drives panel realigns
             return None
         use_pallas = mode == "single"
+        spec_k = int(speculate_k)
+        if spec_k:
+            from ..ops.fused import DENSE_BLOCK_THRESHOLD as _DBT
+
+            K_x = _bucket(self._K(tlen0) + MAX_DRIFT, 8)
+            # the speculative launch carries (2 + k) segments of
+            # duplicated reads — its working set, not the serial one,
+            # must fit unchunked
+            chunk_x = _pick_read_chunk(
+                (2 + spec_k) * self.batch.n_reads, K_x, Tmax + 1,
+                self.hbm_budget,
+            )
+            if chunk_x or Tmax + 1 > _DBT:
+                spec_k = 0
+            else:
+                use_pallas = False
         # K in the key: a re-entry after a drift bail re-centers the
         # drift budget on the NEW entry length, so a cached runner whose
         # compiled band height only covered the OLD entry length must
@@ -725,6 +752,7 @@ class BatchAligner:
         chunk0 = _pick_read_chunk(n_reads, K, T1, self.hbm_budget)
         seg_pair = (
             not use_pallas
+            and not spec_k  # the speculative launch packs the pair too
             and segment_pack_enabled()
             and (not chunk0 or chunk0 >= n_reads)
             and 2 * n_reads <= 128
@@ -732,7 +760,7 @@ class BatchAligner:
         )
         key = (Tmax, K, use_pallas, do_indels, min_dist, history_cap,
                stop_on_same, use_edits, impl, seg_pair, self.band_dtype,
-               self.input_enc)
+               self.input_enc, spec_k)
         if key in self._stage_runners:
             return self._stage_runners[key]
         bw_dev = jnp.asarray(self.bandwidths)
@@ -758,12 +786,14 @@ class BatchAligner:
             base = _xla_stage_runner(
                 K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
                 history_cap, stop_on_same, use_edits, seg_pair,
-                self.band_dtype,
+                self.band_dtype, spec_k,
             )
             # one roofline record per compiled shape (like the Pallas
             # branch): lane occupancy against the 128-lane vector axis,
             # with segment-pair packing the re-score rides 2x the lanes
-            n_live = 2 * n_reads if seg_pair else n_reads
+            # and a speculative launch (2 + k)x
+            n_live = ((2 + spec_k) * n_reads if spec_k
+                      else 2 * n_reads if seg_pair else n_reads)
             _dense_cols(_bucket(T1, 64), K, Npad=_bucket(n_live, 128),
                         want_stats=use_edits, impl="xla", n_live=n_live,
                         band_dtype=self.band_dtype,
@@ -778,6 +808,7 @@ class BatchAligner:
             return base(consensus, prev_score, iters_left, prev_iters,
                         step_state=state)
 
+        runner.speculate_k = spec_k
         self._stage_runners[key] = runner
         return runner
 
@@ -1515,7 +1546,7 @@ def _pallas_stage_runner(K, T1p, C, do_indels, min_dist,
 @functools.lru_cache(maxsize=64)
 def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
                       history_cap, stop_on_same, use_edits=False,
-                      seg_pair=False, band_dtype="f32"):
+                      seg_pair=False, band_dtype="f32", speculate_k=0):
     """Compiled device stage loop over the fused XLA scan step (any
     backend / f64 exactness runs). step_state = ((seq, match, mismatch,
     ins, dels), lengths, bandwidths, weights).
@@ -1528,7 +1559,13 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
     second dispatch. Bit-identical to the conditional path: segment 0's
     reductions walk the same lanes in the same order with exact zeros
     in segment 1's lanes (the unchunked fused step and the segmented
-    step share _dense_batch/masked_weighted_sum)."""
+    step share _dense_batch/masked_weighted_sum).
+
+    ``speculate_k`` > 0 builds every scoring round as a
+    (2 + speculate_k)-segment launch over the reads duplicated per
+    segment — {multi, single-best, speculative composite(s)} — for
+    device_loop's speculative body; same bit-exactness argument as
+    ``seg_pair``, per segment."""
     import jax.numpy as jnp
 
     from ..ops.align_jax import BandGeometry
@@ -1544,23 +1581,22 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
         )
         return unpack_tables(packed, n_reads, T1, use_edits)
 
-    seg_step = None
-    if seg_pair:
-
-        def seg_step(tmpls, tlens, s):
+    def _multi_seg_step(n_seg):
+        # one segment-packed launch scoring n_seg templates over the
+        # reads duplicated per segment
+        def step(tmpls, tlens, s):
             (seq, match, mismatch, ins, dels), lengths, bw, weights = s
 
-            def two(a):
-                return jnp.concatenate([a, a], axis=0)
+            def tile(a):
+                return jnp.concatenate([a] * n_seg, axis=0)
 
             seg = jnp.concatenate([
-                jnp.zeros((n_reads,), jnp.int32),
-                jnp.ones((n_reads,), jnp.int32),
+                jnp.full((n_reads,), i, jnp.int32) for i in range(n_seg)
             ])
             out = fused_step_segmented(
-                tmpls[:, :Tmax], tlens, seg, two(seq), two(match),
-                two(mismatch), two(ins), two(dels), two(lengths),
-                two(bw), two(weights), K, 2,
+                tmpls[:, :Tmax], tlens, seg, tile(seq), tile(match),
+                tile(mismatch), tile(ins), tile(dels), tile(lengths),
+                tile(bw), tile(weights), K, n_seg,
                 want_stats=use_edits, want_tables=True,
                 band_dtype=band_dtype,
             )
@@ -1572,13 +1608,19 @@ def _xla_stage_runner(K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
                 tables += (out["edits"].astype(out["sub"].dtype),)
             return tables
 
+        return step
+
+    seg_step = _multi_seg_step(2) if seg_pair else None
+    spec_step = _multi_seg_step(2 + speculate_k) if speculate_k else None
+
     return make_stage_runner(
         step_fn, do_indels, min_dist, history_cap, Tmax, stop_on_same,
         gate="edits" if use_edits else "none", seg_step_fn=seg_step,
+        speculate_k=speculate_k, spec_step_fn=spec_step,
         aot_key=("realign_stage",
                  K, T1, Tmax, chunk, n_reads, do_indels, min_dist,
                  history_cap, stop_on_same, use_edits, seg_pair,
-                 band_dtype),
+                 band_dtype, speculate_k),
     )
 
 

@@ -380,6 +380,13 @@ def fused_step_segmented(
     zero-masked foreign lanes (see ``segment_weights``): results are
     bit-identical to running each segment in its own block.
 
+    Two callers build S-segment blocks: the lane packer (independent
+    CLUSTERS sharing a block, ``utils.shapes.pack_segments``) and the
+    speculative refine rounds (the SAME reads tiled against
+    ``2 + speculate_k`` candidate templates, ``engine.device_loop``) —
+    the segment mask does not care which axis varies, template or
+    reads.
+
     Returns a dict: ``total [S]``, per-lane ``scores [N]``, dense
     tables ``sub/ins [S, T1, 4]``, ``del [S, T1]``; with ``want_stats``
     also per-lane ``n_errors [N]`` and the per-segment edits union

@@ -146,14 +146,20 @@ def mega_segment_eligible(n_seg: int):
     kernel streams ONE template's columns through its fill/dense/stats
     phases, so a multi-template packed block (one template per segment,
     ops.fused.fused_step_segmented) has no single-launch program here —
-    the planner routes those to the XLA segmented step. The trivial
-    single-segment case is just the normal launch (its epilogue already
-    runs through the shared segment-reduce helpers)."""
+    the planner routes those to the XLA segmented step. This covers
+    both multi-CLUSTER packs (utils.shapes.pack_segments) and the
+    speculative multi-TEMPLATE rounds (RifrafParams.speculate_k tiles
+    the same reads against 2 + k candidate templates): a speculating
+    stage is routed to the XLA runner up front
+    (engine.realign.stage_runner). The trivial single-segment case is
+    just the normal launch (its epilogue already runs through the
+    shared segment-reduce helpers)."""
     if n_seg > 1:
         return False, (
             f"segment-packed launch (n_seg={n_seg}): the megakernel "
             "fills one template per launch; multi-template packed "
-            "blocks run the XLA segmented step"
+            "blocks (cluster packs and speculative rounds alike) run "
+            "the XLA segmented step"
         )
     return True, "mega"
 

@@ -178,6 +178,16 @@ class SweepStats(NamedTuple):
     # journal written under one encoding is never replayed into a run
     # configured for the other
     input_enc: str = "f32"
+    # speculative-refinement accounting (params.speculate_k). The 1+k
+    # extra segment copies of each speculating chunk's read lanes exist
+    # only to score speculative composites: they are OVERHEAD, not
+    # demand, so they are excluded from lane_occupancy/lane_slots above
+    # (which stay comparable to non-speculative baselines) and reported
+    # separately here, alongside the stage loops' attempt/hit counters
+    speculate_k: int = 0
+    spec_overhead_lanes: int = 0
+    spec_attempts: int = 0
+    spec_hits: int = 0
 
 
 class BucketPlan(NamedTuple):
@@ -301,15 +311,18 @@ def _journal_fingerprint(G, infos, clusters, max_iters, min_dist,
                          do_alignment_proposals, lane_target,
                          segment_pack, segment_align, band_dtype,
                          band_growth, guard, verify_fraction,
-                         input_enc) -> str:
+                         input_enc, speculate_k=0) -> str:
     """The sweep journal's resume fingerprint: every knob that changes
     results (or which integrity checks ran) between the run that wrote
     the journal and the run resuming it, plus the cluster content
-    digest. The integrity knobs (guard, verify_fraction) and the input
-    encoding fold in only when non-default (utils.fold_nondefault) so
-    journals minted before each knob existed stay resumable — a guard
-    or verify setting never changes results, but resuming a guarded run
-    unguarded would skip its checks silently."""
+    digest. The integrity knobs (guard, verify_fraction), the input
+    encoding, and speculate_k fold in only when non-default
+    (utils.fold_nondefault) so journals minted before each knob existed
+    stay resumable — a guard or verify setting never changes results,
+    but resuming a guarded run unguarded would skip its checks
+    silently; speculation is result-identical too, but its journal
+    records different round-level provenance (attempt/hit stats), so a
+    resume must not silently mix the two modes."""
     from ..io.journal import fingerprint
 
     return fingerprint(
@@ -322,6 +335,7 @@ def _journal_fingerprint(G, infos, clusters, max_iters, min_dist,
         *fold_nondefault("guard", bool(guard), False),
         *fold_nondefault("verify_fraction", verify_fraction, 0.0),
         *fold_nondefault("input_enc", input_enc, "f32"),
+        *fold_nondefault("speculate_k", speculate_k, 0),
     )
 
 
@@ -627,19 +641,25 @@ def _adapt_program(Tmax: int, K: int, want_edge: bool = False,
 @functools.lru_cache(maxsize=None)
 def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
                    use_edits: bool, donate: bool,
-                   band_dtype: str = "f32", input_enc: str = "f32"):
+                   band_dtype: str = "f32", input_enc: str = "f32",
+                   speculate_k: int = 0):
     """The whole INIT stage for a chunk, vmapped over the cluster axis.
     One cached program per (Tmax, K, H, min_dist, gate) signature; XLA's
     jit cache then keys on the batch avals, so every chunk of a bucket
     (and every later call with the same bucket) reuses one executable.
     ``donate`` hands the read-batch buffers to XLA (non-CPU backends) so
-    a finished bucket's HBM is recycled for the next one."""
+    a finished bucket's HBM is recycled for the next one.
+    ``speculate_k`` > 0 compiles the speculative stage loop: every work
+    round scores {multi, single, k composite(s)} as 2+k segments of one
+    fused_step_segmented launch (results stay bit-identical; the packed
+    row grows the 2-scalar [attempts, hits] tail)."""
     import jax
     import jax.numpy as jnp
 
     from ..engine.device_loop import make_stage_runner
     from ..ops import align_jax
-    from ..ops.fused import fused_step_full, unpack_tables
+    from ..ops.fused import (fused_step_full, fused_step_segmented,
+                             unpack_tables)
 
     def step_fn(tmpl, tlen, s):
         (seq_g, match_g, mismatch_g, ins_g, dels_g), lengths_g, bw_g, \
@@ -651,9 +671,41 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
         )
         return unpack_tables(packed, seq_g.shape[0], Tmax + 1, use_edits)
 
+    spec_step = None
+    if speculate_k:
+        S = 2 + speculate_k
+
+        def spec_step(tmpls, tlens, s):
+            # one segment-packed launch scoring all S templates over the
+            # cluster's reads duplicated per segment (same construction
+            # as realign's speculative step; per-segment reductions are
+            # bit-identical to per-template fused_step_full runs)
+            (seq_g, match_g, mismatch_g, ins_g, dels_g), lengths_g, \
+                bw_g, w_g = s
+            n_reads = seq_g.shape[0]
+
+            def tile(a):
+                return jnp.concatenate([a] * S, axis=0)
+
+            seg = jnp.concatenate([
+                jnp.full((n_reads,), i, jnp.int32) for i in range(S)
+            ])
+            out = fused_step_segmented(
+                tmpls[:, :Tmax], tlens, seg, tile(seq_g), tile(match_g),
+                tile(mismatch_g), tile(ins_g), tile(dels_g),
+                tile(lengths_g), tile(bw_g), tile(w_g), K, S,
+                want_stats=use_edits, want_tables=True,
+                band_dtype=band_dtype,
+            )
+            tables = (out["total"], out["sub"], out["ins"], out["del"])
+            if use_edits:
+                tables += (out["edits"].astype(out["sub"].dtype),)
+            return tables
+
     runner = make_stage_runner(
         step_fn, do_indels=True, min_dist=min_dist, H=H, Tmax=Tmax,
         stop_on_same=True, gate="edits" if use_edits else "none",
+        speculate_k=speculate_k, spec_step_fn=spec_step,
     )
 
     def call(t0, tl, step_state):
@@ -668,7 +720,8 @@ def _stage_program(Tmax: int, K: int, H: int, min_dist: int,
 
     return aot_program(
         "sweep_stage",
-        (Tmax, K, H, min_dist, use_edits, donate, band_dtype, input_enc),
+        (Tmax, K, H, min_dist, use_edits, donate, band_dtype, input_enc,
+         speculate_k),
         jax.jit(call, donate_argnums=(2,) if donate else ()),
     )
 
@@ -783,7 +836,7 @@ class ChunkExecutor:
                  do_alignment_proposals: bool = False, device=None,
                  band_dtype: str = "f32", band_growth: str = "double",
                  bw_sink=None, want_guard: bool = False,
-                 input_enc: str = "f32"):
+                 input_enc: str = "f32", speculate_k: int = 0):
         import jax
 
         from ..engine.params import resolve_dtype
@@ -793,6 +846,10 @@ class ChunkExecutor:
             raise ValueError("pass mesh OR device, not both")
         if band_dtype not in ("f32", "bf16"):
             raise ValueError(f"unknown band_dtype: {band_dtype!r}")
+        if speculate_k not in (0, 1, 2):
+            raise ValueError(
+                f"speculate_k must be 0, 1, or 2, got {speculate_k!r}"
+            )
         check_band_growth(band_growth)
         check_input_enc(input_enc)
         self.mesh = mesh
@@ -824,6 +881,15 @@ class ChunkExecutor:
         # the fetched stage totals host-side. Off by default: the
         # unguarded programs are byte-identical to pre-guard code.
         self.want_guard = want_guard
+        # speculative edit-set evaluation (params.speculate_k): per-chunk
+        # buckets whose Tmax exceeds the segmented step's dense-block
+        # threshold fall back to the serial program (results identical
+        # either way). Attempt/hit counters accumulate here across
+        # collect() calls; each fleet executor is driven by one worker
+        # thread, so plain ints suffice.
+        self.speculate_k = speculate_k
+        self.spec_attempts = 0
+        self.spec_hits = 0
 
     def _check_guard(self, guard, stage: str, owners):
         """Validate fetched per-chunk-row guard flags (raises
@@ -1002,25 +1068,34 @@ class ChunkExecutor:
             (sq_d, mt_d, mm_d, gi_d, dl_d), ln_d,
             shard(bandwidths, None), w_d,
         )
+        spec_k = self.speculate_k
+        if spec_k:
+            from ..ops.fused import DENSE_BLOCK_THRESHOLD
+
+            if Tmax + 1 > DENSE_BLOCK_THRESHOLD:
+                spec_k = 0
         packed = _stage_program(
             Tmax, K, self.H, self.min_dist, self.use_edits, self.donate,
-            self.band_dtype, self.input_enc,
+            self.band_dtype, self.input_enc, spec_k,
         )(t0_d, tl_d, step_state)
-        return packed, plan, idxs
+        return packed, plan, idxs, spec_k
 
     def collect(self, handle) -> List[SweepResult]:
         """Blocking fetch + unpack: one SweepResult per index of the
         chunk, in ``idxs`` order (padding slots dropped)."""
         from ..engine.device_loop import unpack_stage_packed
 
-        packed_dev, plan, idxs = handle
+        packed_dev, plan, idxs, spec_k = handle
         packed = np.asarray(packed_dev)
         Tmax = plan.key[2]
         results = []
         for g in range(len(idxs)):
-            tlen, total, n_rec, completed, _, _, _, tmpl = (
-                unpack_stage_packed(packed[g], self.H, Tmax)
-            )
+            out = unpack_stage_packed(packed[g], self.H, Tmax,
+                                      speculate=bool(spec_k))
+            tlen, total, n_rec, completed, _, _, _, tmpl = out[:8]
+            if spec_k:
+                self.spec_attempts += out[8]
+                self.spec_hits += out[9]
             results.append(SweepResult(
                 consensus=tmpl[:tlen], score=total, n_iters=n_rec,
                 converged=completed,
@@ -1237,6 +1312,7 @@ def sweep_clusters_sharded(
     guard: bool = False,
     verify_fraction: float = 0.0,
     input_enc: str = "f32",
+    speculate_k: int = 0,
 ):
     """One consensus per cluster, all clusters in one device program.
 
@@ -1300,6 +1376,19 @@ def sweep_clusters_sharded(
     default) so ``resume=True`` refuses to mix a journal written under
     one encoding into a run configured for the other.
 
+    ``speculate_k`` > 0 turns on speculative edit-set evaluation inside
+    the whole-block stage programs (params.speculate_k): each work
+    round scores 2+k templates as segments of one
+    ``fused_step_segmented`` launch and skips the next round whenever
+    the replayed greedy rule lands on a speculative composite. Results
+    are ALWAYS bit-identical to the serial path; buckets whose Tmax
+    exceeds the segmented step's dense-block threshold, and
+    segment-packed buckets (whose lane axis already carries multiple
+    clusters), silently run serial. Attempt/hit totals land in
+    ``SweepStats``; the extra segment lanes are reported as
+    ``spec_overhead_lanes`` and excluded from the lane-occupancy
+    metrics, which stay comparable to non-speculative baselines.
+
     Returns the per-cluster results IN INPUT ORDER; with
     ``return_stats`` also a SweepStats (per-bucket occupancy, padding
     waste, and timing).
@@ -1317,6 +1406,10 @@ def sweep_clusters_sharded(
     from ..ops.encoding import check_input_enc
 
     check_input_enc(input_enc)
+    if speculate_k not in (0, 1, 2):
+        raise ValueError(
+            f"speculate_k must be 0, 1, or 2, got {speculate_k!r}"
+        )
     infos = _cluster_infos(clusters, band_growth)
     n_axis = mesh.devices.size if mesh is not None else 1
     plans = plan_sweep(
@@ -1354,6 +1447,7 @@ def sweep_clusters_sharded(
                 band_dtype=band_dtype, band_growth=band_growth,
                 bw_sink=bw_sink if return_stats else None,
                 want_guard=guard, input_enc=input_enc,
+                speculate_k=speculate_k,
             )
             for i in range(n_workers)
         ]
@@ -1365,6 +1459,7 @@ def sweep_clusters_sharded(
             band_dtype=band_dtype, band_growth=band_growth,
             bw_sink=bw_sink if return_stats else None,
             want_guard=guard, input_enc=input_enc,
+            speculate_k=speculate_k,
         )]
 
     tasks = [
@@ -1391,7 +1486,7 @@ def sweep_clusters_sharded(
             read_bucket, band_bucket, do_alignment_proposals,
             lane_target, segment_pack, segment_align,
             band_dtype, band_growth, guard, verify_fraction,
-            input_enc,
+            input_enc, speculate_k,
         )
         journal, prior = open_resumable(
             journal_path,
@@ -1529,6 +1624,9 @@ def sweep_clusters_sharded(
     reads_used = 0
     cluster_lanes = 0
     slots_total = 0
+    spec_overhead = 0
+    if speculate_k:
+        from ..ops.fused import DENSE_BLOCK_THRESHOLD
     for bi, plan in enumerate(plans):
         seg = isinstance(plan, SegmentBucketPlan)
         if seg:
@@ -1558,6 +1656,17 @@ def sweep_clusters_sharded(
         # block, so cluster-lane accounting equals read accounting
         cluster_lanes += reads if seg else n_in * plan.key[0]
         slots_total += slots
+        # speculating buckets tile each cluster's read lanes 2+k times
+        # inside the stage launch; the 1+k copies are overhead lanes,
+        # tracked apart from the demand-side slot accounting (mirrors
+        # ChunkExecutor.run's per-chunk eligibility rule)
+        if (speculate_k and not seg
+                and plan.key[2] + 1 <= DENSE_BLOCK_THRESHOLD):
+            spec_overhead += (
+                len(plan.chunks)
+                * _lane_slots(plan.gp, (2 + speculate_k) * plan.key[0])
+                - slots
+            )
         buckets.append(BucketStats(
             key=plan.key, n_clusters=n_in, n_chunks=len(plan.chunks),
             gp=plan.gp,
@@ -1593,5 +1702,9 @@ def sweep_clusters_sharded(
         band_growth=band_growth,
         bw_hist=_settled_bw_hist(settled_bw),
         input_enc=input_enc,
+        speculate_k=speculate_k,
+        spec_overhead_lanes=spec_overhead,
+        spec_attempts=sum(e.spec_attempts for e in executors),
+        spec_hits=sum(e.spec_hits for e in executors),
     )
     return list(out), stats

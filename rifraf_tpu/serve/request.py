@@ -158,6 +158,15 @@ class ServeConfig:
     # --resume across a changed value is refused. Both encodings can
     # coexist in one process — program caches key on the value
     input_enc: str = "f32"
+    # speculative edit-set evaluation (0 | 1 | 2) — see
+    # engine.params.RifrafParams.speculate_k. Results are bit-identical
+    # to the serial hill-climb (a speculative round is accepted only
+    # when the replayed greedy rule verifies it); the knob changes the
+    # compiled stage programs and the journaled round provenance, so it
+    # keys the program caches and folds into the spool fingerprint when
+    # non-default. The extra segment lanes are counted as overhead in
+    # ServerStats, keeping lane-occupancy comparable across settings
+    speculate_k: int = 0
     # scores/bandwidth used by encode_cluster() and the singleton
     # fallback path; clusters submitted as ready-made ReadScores must
     # have been built with the SAME values or fallback results will not

@@ -38,6 +38,7 @@ class ServerStats:
         self._useful_lanes = 0
         self._cluster_lanes = 0
         self._lane_slots = 0
+        self._spec_overhead_lanes = 0
         self._model_bytes = 0.0
         self._declines: Dict[str, int] = {}
         # load estimators (elastic scaling + admission control):
@@ -94,12 +95,18 @@ class ServerStats:
 
     def note_batch(self, n_real: int, gp: int, useful_cells: int,
                    padded_cells: int, useful_lanes: int = 0,
-                   lane_slots: int = 0, cluster_lanes: int = 0) -> None:
+                   lane_slots: int = 0, cluster_lanes: int = 0,
+                   spec_overhead_lanes: int = 0) -> None:
         """One dispatched micro-batch: ``n_real`` live requests padded
         to a ``gp``-cluster chunk of ``padded_cells`` read-lane cells
         occupying ``lane_slots`` hardware 128-lane slots, of which
         ``cluster_lanes`` belong to a real request's Npad block and
-        ``useful_lanes`` carried a real read."""
+        ``useful_lanes`` carried a real read. ``spec_overhead_lanes``
+        counts the extra segment copies a speculative stage launch
+        (ServeConfig.speculate_k) tiles alongside the demand lanes —
+        overhead, not demand, so it is tracked apart from ``lane_slots``
+        and the lane-occupancy ratios stay comparable across
+        speculation settings."""
         with self._lock:
             self._batches += 1
             self._batched_requests += n_real
@@ -109,6 +116,7 @@ class ServerStats:
             self._useful_lanes += useful_lanes
             self._lane_slots += lane_slots
             self._cluster_lanes += cluster_lanes
+            self._spec_overhead_lanes += spec_overhead_lanes
 
     def note_model_bytes(self, nbytes: float) -> None:
         """Fold one micro-batch's modelled HBM traffic (utils.roofline
@@ -198,6 +206,9 @@ class ServerStats:
                 "lane_occupancy_reads": round(
                     self._useful_lanes / self._lane_slots, 4
                 ) if self._lane_slots else None,
+                # speculative segment copies (overhead, not demand —
+                # excluded from the occupancy ratios above)
+                "spec_overhead_lanes": self._spec_overhead_lanes,
                 "model_gb": round(self._model_bytes / 1e9, 3),
                 "service_ewma_ms": round(self._service_ewma * 1e3, 3)
                 if self._service_ewma is not None else None,

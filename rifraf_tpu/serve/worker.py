@@ -161,6 +161,7 @@ class Worker:
             band_growth=config.band_growth,
             want_guard=config.guard,
             input_enc=config.input_enc,
+            speculate_k=config.speculate_k,
         )
         # result-integrity surface: the per-device scoreboard (shared
         # across the fleet) attributes guard trips / divergences to
@@ -312,6 +313,17 @@ class Worker:
                       else self.executor.run(packed))
         N, L = plan.key[0], plan.key[1]
         n_reads = sum(r.info.n_reads for r in flush.requests)
+        # whole-block batches speculate when the executor's per-chunk
+        # eligibility holds (ChunkExecutor.run): the 1+k extra segment
+        # copies of the chunk's lanes are overhead, not demand
+        spec_over = 0
+        if not seg and self.executor.speculate_k:
+            from ..ops.fused import DENSE_BLOCK_THRESHOLD
+
+            if plan.key[2] + 1 <= DENSE_BLOCK_THRESHOLD:
+                k = self.executor.speculate_k
+                spec_over = (_lane_slots(plan.gp, (2 + k) * N)
+                             - _lane_slots(plan.gp, N))
         self.stats.note_batch(
             n_real=len(flush.requests), gp=plan.gp,
             useful_cells=sum(r.info.useful for r in flush.requests),
@@ -323,6 +335,7 @@ class Worker:
             # block, so the corrected occupancy counts reads
             cluster_lanes=(n_reads if seg
                            else len(flush.requests) * N),
+            spec_overhead_lanes=spec_over,
         )
         return flush, handle
 
@@ -481,6 +494,7 @@ class Worker:
                     band_dtype=cfg.band_dtype,
                     band_growth=cfg.band_growth,
                     input_enc=cfg.input_enc,
+                    speculate_k=cfg.speculate_k,
                 ),
             )
         self.stats.count("fallback")

@@ -341,7 +341,7 @@ def test_default_config_selects_device_loop(monkeypatch):
     orig = realign_mod.BatchAligner.stage_runner
 
     def spy(self, tlen0, do_indels, min_dist, history_cap, stop_on_same,
-            use_edits=False):
+            use_edits=False, speculate_k=0):
         calls.append({"use_edits": use_edits, "do_indels": do_indels})
         return None
 

@@ -1,0 +1,276 @@
+"""Speculative multi-edit proposal evaluation (engine.device_loop):
+composer separation properties, coordinate-remap exactness, packed
+layout invariance, and spec-vs-serial driver/sweep bit-identity.
+
+The CI kernels job runs this file under both RIFRAF_TPU_FUSED_IMPL
+legs with no marker filter (slow included); tier-1 picks up only the
+fast unit tests."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from rifraf_tpu.engine import device_loop as dl
+from rifraf_tpu.engine.driver import rifraf
+from rifraf_tpu.engine.params import RifrafParams, check_params
+from rifraf_tpu.models.errormodel import ErrorModel
+from rifraf_tpu.sim.sample import sample_sequences
+from rifraf_tpu.utils.phred import phred_to_log_p
+
+SEQ_ERRORS = ErrorModel(1.0, 2.0, 2.0, 0.0, 0.0)
+
+
+def _random_candidates(rng, Tmax, n_good, max_pos=None):
+    """A cand_flat vector in _flat_candidates layout (4 ins@0 slots then
+    Tmax blocks of [4 subs, 1 del, 4 ins_next]) with ``n_good``
+    improving slots at random positions; everything else NEG. With
+    ``max_pos`` the improving slots stay in the first ``max_pos``
+    blocks so every decoded edit lands well inside a shorter live
+    template."""
+    n = 4 + Tmax * 9
+    hi = 4 + (max_pos if max_pos is not None else Tmax) * 9
+    flat = np.full((n,), dl.NEG)
+    idx = rng.choice(hi, size=min(n_good, hi), replace=False)
+    flat[idx] = rng.uniform(0.1, 5.0, size=len(idx))
+    return jnp.asarray(flat)
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("min_dist", [5, 9, 15])
+def test_composite_separation(seed, min_dist):
+    """The speculative layer-2 set is disjoint from layer 1, keeps
+    ``near_radius`` clear of every layer-1 anchor, and enforces the
+    full min_dist among its own picks — for both the composite radius
+    (SPEC_NEAR_RADIUS) and the single-best radius-2 floor."""
+    rng = np.random.default_rng(seed)
+    Tmax = 64
+    cand = _random_candidates(rng, Tmax, n_good=60)
+    vals, ok, kind, pos, base, anchor, keep, n_imp = dl._choose_parts(
+        cand, min_dist
+    )
+    ok_h, anchor_h, keep_h = map(np.asarray, (ok, anchor, keep))
+    assert np.any(keep_h)
+    for near in (2, dl.SPEC_NEAR_RADIUS):
+        keep2 = np.asarray(
+            dl._choose_next_set(ok, anchor, keep, min_dist,
+                                near_radius=near)
+        )
+        assert not np.any(keep2 & keep_h)
+        assert np.all(ok_h[keep2])
+        a1 = anchor_h[keep_h]
+        a2 = anchor_h[keep2]
+        if len(a2) and len(a1):
+            assert np.abs(a2[:, None] - a1[None, :]).min() >= near
+        if len(a2) > 1:
+            d = np.abs(a2[:, None] - a2[None, :])
+            np.fill_diagonal(d, 10**9)
+            assert d.min() >= min_dist
+    # (no size monotonicity across radii: a radius-2 walk can admit an
+    # early near candidate that then min-dist-blocks several later
+    # ones — only the separation invariants above are guaranteed)
+
+
+def test_near_radius_floor():
+    """Radii below 2 would break the _remap_pos exactness argument and
+    must be rejected outright."""
+    z = jnp.zeros((dl.CAP,), jnp.int32)
+    with pytest.raises(AssertionError):
+        dl._choose_next_set(z > 0, z, z > 0, 9, near_radius=1)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_two_stage_apply_matches_union(seed):
+    """The composite's defining identity: applying layer 1 and then the
+    remapped layer 2 reproduces a single _apply of the union on
+    original coordinates — and the result respects Tmax."""
+    rng = np.random.default_rng(100 + seed)
+    Tmax, tlen, min_dist = 96, 64, 7
+    tmpl = np.zeros(Tmax, np.int8)
+    tmpl[:tlen] = rng.integers(0, 4, tlen)
+    tmpl = jnp.asarray(tmpl)
+    cand = _random_candidates(rng, Tmax, n_good=80, max_pos=tlen - 4)
+    vals, ok, kind, pos, base, anchor, keep, _ = dl._choose_parts(
+        cand, min_dist
+    )
+    keep2 = dl._choose_next_set(ok, anchor, keep, min_dist, near_radius=2)
+
+    t1, l1 = dl._apply(tmpl, tlen, kind, pos, base, keep, Tmax)
+    inc, exc = dl._indel_shifts(tlen, kind, pos, keep, Tmax)
+    pos_r = dl._remap_pos(pos, inc, exc)
+    sep = bool(dl._spec_sep_ok(kind, pos_r, keep2, Tmax))
+    t2, l2 = dl._apply(t1, l1, kind, pos_r, base, keep2, Tmax)
+    tu, lu = dl._apply(tmpl, tlen, kind, pos, base, keep | keep2, Tmax)
+
+    n_ins2 = int(np.sum(np.asarray(keep2) & (np.asarray(kind) == 2)))
+    n_del2 = int(np.sum(np.asarray(keep2) & (np.asarray(kind) == 1)))
+    assert int(l2) == int(l1) + n_ins2 - n_del2
+    assert int(l2) <= Tmax
+    assert sep  # min_dist 7 >= 4: the floor can never be crossed
+    assert int(l2) == int(lu)
+    assert np.array_equal(
+        np.asarray(t2)[: int(l2)], np.asarray(tu)[: int(lu)]
+    )
+
+
+def test_spec_sep_ok_cases():
+    """Direct accept/reject cases for the post-remap separation guard
+    (sub/del anchor = pos+1, ins anchor = pos; pairwise floor 2)."""
+    Tmax = 32
+
+    def run(edits):
+        kind = np.zeros(dl.CAP, np.int32)
+        pos = np.zeros(dl.CAP, np.int32)
+        keep2 = np.zeros(dl.CAP, bool)
+        for i, (k, p) in enumerate(edits):
+            kind[i], pos[i], keep2[i] = k, p, True
+        return bool(
+            dl._spec_sep_ok(jnp.asarray(kind), jnp.asarray(pos),
+                            jnp.asarray(keep2), Tmax)
+        )
+
+    assert run([])  # empty composite is trivially valid
+    assert run([(0, 5)])
+    assert run([(0, 5), (0, 7)])  # anchors 6, 8
+    assert not run([(0, 5), (0, 6)])  # anchors 6, 7: gap 1
+    assert not run([(0, 5), (2, 6)])  # sub anchor 6 == ins anchor 6
+    assert run([(0, 5), (2, 8)])  # anchors 6, 8
+    assert run([(2, 0), (1, 1)])  # ins@0 (anchor 0) vs del@1 (anchor 2)
+
+
+def test_packed_layout_front_offsets_identical():
+    """speculate_k=0 rows keep the byte-identical legacy layout; the
+    speculative tail is strictly appended."""
+    rng = np.random.default_rng(7)
+    H, Tmax = 3, 5
+    hlen = rng.integers(1, Tmax + 1, H).astype(float)
+    hist = rng.integers(0, 4, H * Tmax).astype(float)
+    tmpl = rng.integers(0, 4, Tmax).astype(float)
+    base = np.concatenate([[4.0, 1.25, 3.0, 1.0, 0.5], hlen, hist, tmpl])
+    spec = np.concatenate([base, [11.0, 4.0]])
+
+    a = dl.unpack_stage_packed(base, H, Tmax)
+    b = dl.unpack_stage_packed(spec, H, Tmax, speculate=True)
+    assert len(a) == 8 and len(b) == 10
+    for x, y in zip(a, b[:8]):
+        if isinstance(x, np.ndarray):
+            assert np.array_equal(x, y)
+        else:
+            assert x == y
+    assert b[8] == 11 and b[9] == 4
+
+
+def test_validation_errors():
+    """Bad speculate_k is rejected at every entry point."""
+    with pytest.raises(ValueError, match="speculate_k"):
+        dl.make_stage_runner(None, do_indels=True, min_dist=9, H=4,
+                             Tmax=16, stop_on_same=False, speculate_k=3)
+    with pytest.raises(ValueError, match="spec_step_fn"):
+        dl.make_stage_runner(None, do_indels=True, min_dist=9, H=4,
+                             Tmax=16, stop_on_same=False, speculate_k=1)
+    params = RifrafParams(speculate_k=3)
+    with pytest.raises(ValueError, match="speculate_k"):
+        check_params(params.scores, 0, params)
+
+    from rifraf_tpu.parallel.sweep_sharded import ChunkExecutor
+    with pytest.raises(ValueError, match="speculate_k"):
+        ChunkExecutor(speculate_k=5)
+
+
+def _sampled_run(nseqs, length, error_rate, seed, dap, speculate_k):
+    rng = np.random.default_rng(seed)
+    _, template, _, seqs, _, phreds, _, _ = sample_sequences(
+        nseqs=nseqs, length=length, error_rate=error_rate, rng=rng,
+        seq_errors=SEQ_ERRORS,
+    )
+    log_ps = [phred_to_log_p(np.asarray(p, float)) for p in phreds]
+    return rifraf(
+        seqs, error_log_ps=log_ps,
+        params=RifrafParams(batch_size=0, batch_fixed=False,
+                            do_alignment_proposals=dap,
+                            device_loop="on", speculate_k=speculate_k),
+    )
+
+
+def test_spec_metadata_small():
+    """Fast leg: a tiny run carries the speculation metadata block in
+    both modes and k=2 reproduces serial exactly."""
+    base = _sampled_run(8, 60, 0.04, seed=3, dap=False, speculate_k=0)
+    spec = _sampled_run(8, 60, 0.04, seed=3, dap=False, speculate_k=2)
+    assert np.array_equal(base.consensus, spec.consensus)
+    assert np.isclose(base.state.score, spec.state.score,
+                      rtol=1e-12, atol=1e-9)
+    m0 = base.metadata["speculation"]
+    m2 = spec.metadata["speculation"]
+    assert not m0["enabled"] and m0["k"] == 0 and m0["attempts"] == 0
+    assert m2["enabled"] and m2["k"] == 2
+    assert 0 <= m2["hits"] <= m2["attempts"]
+    assert m2["hit_rate"] == (
+        m2["hits"] / m2["attempts"] if m2["attempts"] else 0.0
+    )
+    for st in m2["stages"].values():
+        assert st["rounds"] == st["iterations"] - st["hits"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dap", [False, True])
+@pytest.mark.parametrize("k", [1, 2])
+def test_driver_spec_equals_serial(dap, k):
+    """A speculative run is bit-identical to the serial driver —
+    consensus, score, and per-stage iteration counts — whether rounds
+    hit or miss, under both proposal-gating modes."""
+    base = _sampled_run(24, 120, 0.05, seed=205, dap=dap, speculate_k=0)
+    spec = _sampled_run(24, 120, 0.05, seed=205, dap=dap, speculate_k=k)
+    assert np.array_equal(base.consensus, spec.consensus)
+    assert np.isclose(base.state.score, spec.state.score,
+                      rtol=1e-12, atol=1e-9)
+    assert np.array_equal(base.state.stage_iterations,
+                          spec.state.stage_iterations)
+    m = spec.metadata["speculation"]
+    assert m["enabled"] and m["k"] == k
+    assert m["stages"]  # the device loop ran and was accounted
+    total_rounds = sum(st["rounds"] for st in m["stages"].values())
+    total_iters = sum(st["iterations"] for st in m["stages"].values())
+    assert total_rounds == total_iters - m["hits"]
+
+
+@pytest.mark.slow
+def test_sweep_speculate_matches_serial():
+    """The sharded sweep path: speculate_k=2 returns the same
+    consensus/score/iterations per cluster, and SweepStats reports the
+    speculative lanes as overhead."""
+    from rifraf_tpu.models.sequences import make_read_scores
+    from rifraf_tpu.parallel.sweep_sharded import sweep_clusters_sharded
+
+    rng = np.random.default_rng(11)
+    params = RifrafParams()
+    clusters = []
+    for _ in range(3):
+        # enough reads that the (2+k)-tiled lanes spill past one
+        # 128-lane slot — spec_overhead_lanes counts whole lane slots
+        _, _, _, seqs, _, phreds, _, _ = sample_sequences(
+            nseqs=12, length=70, error_rate=0.03, rng=rng,
+            seq_errors=SEQ_ERRORS,
+        )
+        clusters.append([
+            make_read_scores(s, phred_to_log_p(np.asarray(p, float)),
+                             params.bandwidth, params.scores)
+            for s, p in zip(seqs, phreds)
+        ])
+
+    # segment-packed buckets spend the segment axis on cluster packing
+    # and never speculate; force per-cluster stage programs so the
+    # speculative path actually engages on these tiny clusters
+    res0 = sweep_clusters_sharded(clusters, segment_pack=False)
+    res1, stats = sweep_clusters_sharded(clusters, speculate_k=2,
+                                         segment_pack=False,
+                                         return_stats=True)
+    for g, (a, b) in enumerate(zip(res0, res1)):
+        assert np.array_equal(a.consensus, b.consensus), g
+        assert np.isclose(a.score, b.score, rtol=1e-12, atol=1e-9), g
+        assert a.n_iters == b.n_iters, g
+    assert stats.speculate_k == 2
+    assert stats.spec_attempts > 0
+    assert 0 <= stats.spec_hits <= stats.spec_attempts
+    assert stats.spec_overhead_lanes > 0
